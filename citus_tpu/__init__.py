@@ -1,0 +1,53 @@
+"""citus_tpu — a TPU-native distributed query-execution framework.
+
+Brand-new framework with the capabilities of Citus (distributed PostgreSQL,
+surveyed at /root/reference — see SURVEY.md): hash-sharded columnar tables,
+a router/pushdown/repartition planner cascade, and distributed execution —
+rebuilt TPU-first:
+
+* tables live as host-side columnar stripes streamed into HBM as fixed-width
+  padded arrays;
+* co-located and broadcast joins run per-device under ``shard_map``;
+* repartition joins replace COPY-over-TCP shuffles with
+  ``jax.lax.all_to_all`` over ICI;
+* distributed aggregates split into per-device partial aggregation and a
+  collective combine.
+"""
+
+from .config import Settings, registered_vars
+from .errors import (
+    CapacityOverflowError,
+    CatalogError,
+    CitusTpuError,
+    ConfigError,
+    ExecutionError,
+    IngestError,
+    ParseError,
+    PlanningError,
+    StorageError,
+    TransactionError,
+    UnsupportedQueryError,
+)
+from .types import ColumnDef, DataType, TableSchema, sql_type_to_datatype
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Settings", "registered_vars", "ColumnDef", "DataType", "TableSchema",
+    "sql_type_to_datatype", "CitusTpuError", "ConfigError", "CatalogError",
+    "StorageError", "ParseError", "PlanningError", "UnsupportedQueryError",
+    "ExecutionError", "CapacityOverflowError", "IngestError",
+    "TransactionError", "__version__",
+]
+
+
+def connect(data_dir: str | None = None, **settings):
+    """Open a Session (the psql-connection analogue). Lazy import to keep
+    `import citus_tpu` light."""
+    try:
+        from .session import Session
+    except ImportError as exc:  # pragma: no cover - build-order guard
+        raise CitusTpuError(
+            "the session layer is not available in this build") from exc
+
+    return Session(data_dir=data_dir, **settings)

@@ -1,0 +1,434 @@
+"""The distributed catalog: tables, shards, placements, nodes, colocation.
+
+Structural analogue of the reference's metadata layer
+(/root/reference/src/backend/distributed/metadata/ and the pg_dist_* catalogs
+in src/include/distributed/pg_dist_partition.h:22-32, pg_dist_shard.h,
+pg_dist_placement.h, pg_dist_node.h, pg_dist_colocation.h).
+
+Differences driven by the TPU architecture:
+
+* Single-controller JAX replaces "metadata sync to all nodes via 2PC"
+  (metadata_sync.c): there is one catalog, owned by the controller process,
+  persisted as JSON under the data directory through the transaction layer's
+  commit log (atomic rename).  "Query from any node" collapses to ordinary
+  in-process access.
+* "Nodes" are mesh slots (one per TPU device, or per-core group), not
+  host:port pairs; placements map shards to mesh positions.
+"""
+
+from __future__ import annotations
+
+import enum
+import json
+import os
+import threading
+from dataclasses import dataclass
+from typing import Iterable
+
+from ..errors import CatalogError
+from ..types import DataType, TableSchema
+from .distribution import ShardInterval, shard_interval_bounds
+
+
+class DistributionMethod(enum.Enum):
+    """partmethod analogue (pg_dist_partition.h:22-32: h/r/a/n)."""
+
+    HASH = "hash"            # 'h'
+    REFERENCE = "reference"  # single shard replicated to every node
+    LOCAL = "local"          # controller-only table ('n', citus local)
+
+
+class ReplicationModel(enum.Enum):
+    """repmodel analogue."""
+
+    STATEMENT = "statement"
+    TWO_PHASE = "2pc"
+
+
+@dataclass
+class NodeMetadata:
+    """pg_dist_node row analogue: one mesh slot."""
+
+    node_id: int
+    name: str               # e.g. "tpu:0" or "cpu:3"
+    group_id: int
+    is_active: bool = True
+    capacity: float = 1.0   # rebalancer weight (pg_dist_rebalance_strategy)
+
+    def to_json(self) -> dict:
+        return {"node_id": self.node_id, "name": self.name,
+                "group_id": self.group_id, "is_active": self.is_active,
+                "capacity": self.capacity}
+
+    @staticmethod
+    def from_json(o: dict) -> "NodeMetadata":
+        return NodeMetadata(o["node_id"], o["name"], o["group_id"],
+                            o.get("is_active", True), o.get("capacity", 1.0))
+
+
+@dataclass
+class ShardPlacement:
+    """pg_dist_placement row analogue."""
+
+    placement_id: int
+    shard_id: int
+    node_id: int
+    shard_state: str = "active"  # active | to_delete (deferred cleanup)
+    size_bytes: int = 0
+
+    def to_json(self) -> dict:
+        return {"placement_id": self.placement_id, "shard_id": self.shard_id,
+                "node_id": self.node_id, "shard_state": self.shard_state,
+                "size_bytes": self.size_bytes}
+
+    @staticmethod
+    def from_json(o: dict) -> "ShardPlacement":
+        return ShardPlacement(o["placement_id"], o["shard_id"], o["node_id"],
+                              o.get("shard_state", "active"), o.get("size_bytes", 0))
+
+
+@dataclass
+class ColocationGroup:
+    """pg_dist_colocation row analogue."""
+
+    colocation_id: int
+    shard_count: int
+    distribution_dtype: DataType | None
+
+    def to_json(self) -> dict:
+        return {"colocation_id": self.colocation_id,
+                "shard_count": self.shard_count,
+                "distribution_dtype":
+                    self.distribution_dtype.value if self.distribution_dtype else None}
+
+    @staticmethod
+    def from_json(o: dict) -> "ColocationGroup":
+        dt = o.get("distribution_dtype")
+        return ColocationGroup(o["colocation_id"], o["shard_count"],
+                               DataType(dt) if dt else None)
+
+
+@dataclass
+class TableMetadata:
+    """pg_dist_partition row + schema (the reference keeps the schema in
+    PostgreSQL's own catalogs; we carry it here)."""
+
+    name: str
+    schema: TableSchema
+    method: DistributionMethod
+    distribution_column: str | None
+    colocation_id: int
+    replication_model: ReplicationModel = ReplicationModel.TWO_PHASE
+
+    def to_json(self) -> dict:
+        return {"name": self.name, "schema": self.schema.to_json(),
+                "method": self.method.value,
+                "distribution_column": self.distribution_column,
+                "colocation_id": self.colocation_id,
+                "replication_model": self.replication_model.value}
+
+    @staticmethod
+    def from_json(o: dict) -> "TableMetadata":
+        return TableMetadata(
+            o["name"], TableSchema.from_json(o["schema"]),
+            DistributionMethod(o["method"]), o.get("distribution_column"),
+            o["colocation_id"], ReplicationModel(o.get("replication_model", "2pc")))
+
+
+class Catalog:
+    """In-memory catalog with JSON persistence and a version counter.
+
+    The version counter is the invalidation analogue of the reference's
+    metadata cache (metadata/metadata_cache.c:287 InitializeCaches +
+    syscache invalidation callbacks): executors cache compiled plans keyed on
+    (query, catalog_version) and recompile when metadata changes.
+    """
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self.tables: dict[str, TableMetadata] = {}
+        self.shards: dict[int, ShardInterval] = {}
+        self.placements: dict[int, ShardPlacement] = {}
+        self.nodes: dict[int, NodeMetadata] = {}
+        self.colocation_groups: dict[int, ColocationGroup] = {}
+        self.version = 0
+        self._next_shard_id = 102008   # reference shard ids start ~102008
+        self._next_placement_id = 1
+        self._next_node_id = 1
+        self._next_colocation_id = 1
+
+    # -- mutation helpers --------------------------------------------------
+    def _bump(self):
+        self.version += 1
+
+    def allocate_shard_id(self) -> int:
+        with self._lock:
+            sid = self._next_shard_id
+            self._next_shard_id += 1
+            return sid
+
+    def allocate_placement_id(self) -> int:
+        with self._lock:
+            pid = self._next_placement_id
+            self._next_placement_id += 1
+            return pid
+
+    # -- nodes -------------------------------------------------------------
+    def add_node(self, name: str, group_id: int | None = None,
+                 capacity: float = 1.0) -> NodeMetadata:
+        with self._lock:
+            for n in self.nodes.values():
+                if n.name == name:
+                    raise CatalogError(f"node {name!r} already exists")
+            node = NodeMetadata(self._next_node_id, name,
+                                group_id if group_id is not None else self._next_node_id,
+                                True, capacity)
+            self.nodes[node.node_id] = node
+            self._next_node_id += 1
+            # Replicate reference tables to the new node (ref:
+            # EnsureReferenceTablesExistOnAllNodes on node activation,
+            # utils/reference_table_utils.c). Data movement is the ops
+            # layer's job; the catalog records the placement.
+            for meta in self.tables.values():
+                if meta.method == DistributionMethod.REFERENCE:
+                    for s in self.table_shards(meta.name):
+                        self.placements[self._next_placement_id] = ShardPlacement(
+                            self._next_placement_id, s.shard_id, node.node_id)
+                        self._next_placement_id += 1
+            self._bump()
+            return node
+
+    def remove_node(self, name: str) -> None:
+        with self._lock:
+            node = self.node_by_name(name)
+            used = [p for p in self.placements.values()
+                    if p.node_id == node.node_id and p.shard_state == "active"
+                    and self.shards[p.shard_id].min_value is not None]
+            if used:
+                raise CatalogError(
+                    f"cannot remove node {name!r}: it still hosts "
+                    f"{len(used)} shard placement(s); rebalance first")
+            # drop this node's remaining placements (reference-table replicas
+            # and to_delete leftovers) so no placement dangles on a dead node
+            self.placements = {k: p for k, p in self.placements.items()
+                               if p.node_id != node.node_id}
+            del self.nodes[node.node_id]
+            self._bump()
+
+    def node_by_name(self, name: str) -> NodeMetadata:
+        for n in self.nodes.values():
+            if n.name == name:
+                return n
+        raise CatalogError(f"node {name!r} does not exist")
+
+    def active_nodes(self) -> list[NodeMetadata]:
+        return sorted((n for n in self.nodes.values() if n.is_active),
+                      key=lambda n: n.node_id)
+
+    # -- colocation --------------------------------------------------------
+    def get_or_create_colocation_group(
+            self, shard_count: int, dtype: DataType | None) -> ColocationGroup:
+        with self._lock:
+            for g in self.colocation_groups.values():
+                if g.shard_count == shard_count and g.distribution_dtype == dtype:
+                    return g
+            return self.new_colocation_group(shard_count, dtype)
+
+    def new_colocation_group(self, shard_count: int,
+                             dtype: DataType | None) -> ColocationGroup:
+        with self._lock:
+            g = ColocationGroup(self._next_colocation_id, shard_count, dtype)
+            self.colocation_groups[g.colocation_id] = g
+            self._next_colocation_id += 1
+            self._bump()
+            return g
+
+    # -- tables ------------------------------------------------------------
+    def register_table(self, meta: TableMetadata,
+                       shards: Iterable[ShardInterval],
+                       placements: Iterable[ShardPlacement]) -> None:
+        with self._lock:
+            if meta.name in self.tables:
+                raise CatalogError(f"table {meta.name!r} already distributed")
+            self.tables[meta.name] = meta
+            for s in shards:
+                self.shards[s.shard_id] = s
+            for p in placements:
+                self.placements[p.placement_id] = p
+            self._bump()
+
+    def drop_table(self, name: str) -> None:
+        with self._lock:
+            if name not in self.tables:
+                raise CatalogError(f"table {name!r} does not exist")
+            shard_ids = {s.shard_id for s in self.shards.values()
+                         if s.table_name == name}
+            self.shards = {k: v for k, v in self.shards.items()
+                           if v.table_name != name}
+            self.placements = {k: v for k, v in self.placements.items()
+                               if v.shard_id not in shard_ids}
+            del self.tables[name]
+            self._bump()
+
+    def table(self, name: str) -> TableMetadata:
+        t = self.tables.get(name)
+        if t is None:
+            raise CatalogError(f"table {name!r} is not distributed")
+        return t
+
+    def has_table(self, name: str) -> bool:
+        return name in self.tables
+
+    def table_shards(self, name: str) -> list[ShardInterval]:
+        self.table(name)
+        return sorted((s for s in self.shards.values() if s.table_name == name),
+                      key=lambda s: s.shard_index)
+
+    def shard_placements(self, shard_id: int) -> list[ShardPlacement]:
+        return sorted((p for p in self.placements.values()
+                       if p.shard_id == shard_id and p.shard_state == "active"),
+                      key=lambda p: p.placement_id)
+
+    def active_placement(self, shard_id: int) -> ShardPlacement:
+        ps = self.shard_placements(shard_id)
+        if not ps:
+            raise CatalogError(f"shard {shard_id} has no active placement")
+        return ps[0]
+
+    def colocated_tables(self, name: str) -> list[str]:
+        t = self.table(name)
+        return sorted(n for n, m in self.tables.items()
+                      if m.colocation_id == t.colocation_id)
+
+    def tables_colocated(self, a: str, b: str) -> bool:
+        return self.table(a).colocation_id == self.table(b).colocation_id
+
+    # -- distributed table creation (create_distributed_table analogue;
+    #    ref: commands/create_distributed_table.c:222 +
+    #    operations/create_shards.c:83) --------------------------------------
+    def create_distributed_table(
+            self, name: str, schema: TableSchema, distribution_column: str,
+            shard_count: int, colocate_with: str | None = None) -> TableMetadata:
+        with self._lock:
+            if not self.active_nodes():
+                raise CatalogError("no active nodes; call add_node first")
+            dist_col = schema.column(distribution_column)
+            if colocate_with:
+                other = self.table(colocate_with)
+                if other.method != DistributionMethod.HASH:
+                    raise CatalogError(
+                        f"cannot colocate with non-hash table {colocate_with!r}")
+                group = self.colocation_groups[other.colocation_id]
+                if group.distribution_dtype != dist_col.dtype:
+                    raise CatalogError(
+                        "colocated tables need matching distribution column "
+                        f"types ({group.distribution_dtype} vs {dist_col.dtype})")
+                shard_count = group.shard_count
+            else:
+                group = self.get_or_create_colocation_group(shard_count, dist_col.dtype)
+            meta = TableMetadata(name, schema, DistributionMethod.HASH,
+                                 distribution_column, group.colocation_id)
+            nodes = self.active_nodes()
+            shards, placements = [], []
+            for i, (lo, hi) in enumerate(shard_interval_bounds(shard_count)):
+                sid = self.allocate_shard_id()
+                shards.append(ShardInterval(sid, name, i, lo, hi))
+                # round-robin placement (CreateShardsWithRoundRobinPolicy), or
+                # aligned with the colocated table's placements
+                if colocate_with:
+                    sibling = self.table_shards(colocate_with)[i]
+                    node_id = self.active_placement(sibling.shard_id).node_id
+                else:
+                    node_id = nodes[i % len(nodes)].node_id
+                placements.append(ShardPlacement(
+                    self.allocate_placement_id(), sid, node_id))
+            self.register_table(meta, shards, placements)
+            return meta
+
+    def create_reference_table(self, name: str, schema: TableSchema) -> TableMetadata:
+        """Single shard conceptually replicated on every node
+        (ref: utils/reference_table_utils.c; README.md:86-90)."""
+        with self._lock:
+            if not self.active_nodes():
+                raise CatalogError("no active nodes; call add_node first")
+            # all reference tables share one colocation group (ref:
+            # colocation_utils.c CreateReferenceTableColocationId)
+            group = self.get_or_create_colocation_group(1, None)
+            meta = TableMetadata(name, schema, DistributionMethod.REFERENCE,
+                                 None, group.colocation_id)
+            sid = self.allocate_shard_id()
+            shard = ShardInterval(sid, name, 0, None, None)
+            placements = [ShardPlacement(self.allocate_placement_id(), sid,
+                                         n.node_id)
+                          for n in self.active_nodes()]
+            self.register_table(meta, [shard], placements)
+            return meta
+
+    def create_local_table(self, name: str, schema: TableSchema) -> TableMetadata:
+        with self._lock:
+            group = self.new_colocation_group(1, None)
+            meta = TableMetadata(name, schema, DistributionMethod.LOCAL,
+                                 None, group.colocation_id)
+            sid = self.allocate_shard_id()
+            shard = ShardInterval(sid, name, 0, None, None)
+            node = self.active_nodes()[0] if self.active_nodes() else None
+            placements = ([ShardPlacement(self.allocate_placement_id(), sid,
+                                          node.node_id)] if node else [])
+            self.register_table(meta, [shard], placements)
+            return meta
+
+    # -- persistence -------------------------------------------------------
+    def to_json(self) -> dict:
+        return {
+            "version": self.version,
+            "next_shard_id": self._next_shard_id,
+            "next_placement_id": self._next_placement_id,
+            "next_node_id": self._next_node_id,
+            "next_colocation_id": self._next_colocation_id,
+            "tables": {k: v.to_json() for k, v in self.tables.items()},
+            "shards": {str(k): v.to_json() for k, v in self.shards.items()},
+            "placements": {str(k): v.to_json() for k, v in self.placements.items()},
+            "nodes": {str(k): v.to_json() for k, v in self.nodes.items()},
+            "colocation_groups": {str(k): v.to_json()
+                                  for k, v in self.colocation_groups.items()},
+        }
+
+    @staticmethod
+    def from_json(obj: dict) -> "Catalog":
+        cat = Catalog()
+        cat.version = obj.get("version", 0)
+        cat._next_shard_id = obj.get("next_shard_id", 102008)
+        cat._next_placement_id = obj.get("next_placement_id", 1)
+        cat._next_node_id = obj.get("next_node_id", 1)
+        cat._next_colocation_id = obj.get("next_colocation_id", 1)
+        cat.tables = {k: TableMetadata.from_json(v)
+                      for k, v in obj.get("tables", {}).items()}
+        cat.shards = {int(k): ShardInterval.from_json(v)
+                      for k, v in obj.get("shards", {}).items()}
+        cat.placements = {int(k): ShardPlacement.from_json(v)
+                          for k, v in obj.get("placements", {}).items()}
+        cat.nodes = {int(k): NodeMetadata.from_json(v)
+                     for k, v in obj.get("nodes", {}).items()}
+        cat.colocation_groups = {int(k): ColocationGroup.from_json(v)
+                                 for k, v in obj.get("colocation_groups", {}).items()}
+        return cat
+
+    def save(self, path: str) -> None:
+        """Atomic write (tmp + rename) — the catalog's durability primitive."""
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.to_json(), f, indent=1)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        # fsync the directory so the rename itself is durable
+        dir_fd = os.open(os.path.dirname(os.path.abspath(path)), os.O_RDONLY)
+        try:
+            os.fsync(dir_fd)
+        finally:
+            os.close(dir_fd)
+
+    @staticmethod
+    def load(path: str) -> "Catalog":
+        with open(path) as f:
+            return Catalog.from_json(json.load(f))

@@ -1,0 +1,225 @@
+"""Configuration variable registry (the GUC analogue).
+
+The reference registers 145 `citus.*` GUCs in one place
+(/root/reference/src/backend/distributed/shared_library_init.c:982,
+RegisterCitusConfigVariables) with typed definitions, defaults, ranges, and
+docstrings.  This module mirrors that shape: a central typed registry, a
+session-scoped settings object, and `set`/`get`/`show_all` with validation.
+
+Only variables that are meaningful for the TPU build are defined; each entry
+cites the reference GUC it corresponds to where one exists.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from dataclasses import dataclass
+from typing import Any, Callable
+
+from .errors import ConfigError
+
+
+@dataclass(frozen=True)
+class ConfigVar:
+    name: str
+    default: Any
+    doc: str
+    vartype: type = int
+    min_value: Any = None
+    max_value: Any = None
+    choices: tuple | None = None
+    validate: Callable[[Any], None] | None = None
+
+
+_REGISTRY: dict[str, ConfigVar] = {}
+
+
+def _register(var: ConfigVar) -> None:
+    if var.name in _REGISTRY:
+        raise ConfigError(f"duplicate config var {var.name}")
+    _REGISTRY[var.name] = var
+
+
+def registered_vars() -> dict[str, ConfigVar]:
+    return dict(_REGISTRY)
+
+
+# --- sharding / placement -------------------------------------------------
+_register(ConfigVar(
+    "shard_count", 8,
+    "Number of hash shards for new distributed tables "
+    "(ref: citus.shard_count, shared_library_init.c:2616).",
+    int, min_value=1, max_value=64000))
+_register(ConfigVar(
+    "shard_replication_factor", 1,
+    "Placement replicas per shard (ref: citus.shard_replication_factor).",
+    int, min_value=1, max_value=100))
+
+# --- executor -------------------------------------------------------------
+_register(ConfigVar(
+    "max_adaptive_executor_pool_size", 16,
+    "Max concurrent host-side tasks per node — bounds async dispatch "
+    "(ref: citus.max_adaptive_executor_pool_size, shared_library_init.c:2087).",
+    int, min_value=1, max_value=1024))
+_register(ConfigVar(
+    "enable_repartition_joins", True,
+    "Allow dual/single repartition (all_to_all) joins "
+    "(ref: citus.enable_repartition_joins, shared_library_init.c:1609).",
+    bool))
+_register(ConfigVar(
+    "task_assignment_policy", "greedy",
+    "How tasks map to placements (ref: citus.task_assignment_policy).",
+    str, choices=("greedy", "round-robin", "first-replica")))
+_register(ConfigVar(
+    "compute_dtype", "float32",
+    "Device accumulation dtype: float32 (TPU-fast) or float64 (exact; CPU "
+    "test meshes). No reference equivalent — TPU-specific policy.",
+    str, choices=("float32", "float64")))
+_register(ConfigVar(
+    "repartition_capacity_factor", 1.5,
+    "Static all_to_all buffer headroom over expected rows/partition. "
+    "Overflow triggers host-level retry with doubled capacity.",
+    float, min_value=1.0, max_value=64.0))
+_register(ConfigVar(
+    "join_output_capacity_factor", 1.0,
+    "Static join-output headroom over probe-side capacity.",
+    float, min_value=0.1, max_value=64.0))
+_register(ConfigVar(
+    "enable_pallas_kernels", True,
+    "Use hand-written Pallas TPU kernels for hot ops where available; "
+    "fall back to pure XLA lowering otherwise.",
+    bool))
+
+# --- columnar storage (ref: columnar GUCs + columnar.options catalog) -----
+_register(ConfigVar(
+    "columnar_stripe_row_limit", 150_000,
+    "Rows per stripe (ref default 150000, columnar/README.md:96-112).",
+    int, min_value=1_000, max_value=10_000_000))
+_register(ConfigVar(
+    "columnar_chunk_group_row_limit", 10_000,
+    "Rows per chunk group (ref default 10000).",
+    int, min_value=128, max_value=1_000_000))
+_register(ConfigVar(
+    "columnar_compression", "zstd",
+    "Per-chunk compression codec (ref: none/pglz/lz4/zstd; here "
+    "none/zlib/zstd).", str, choices=("none", "zlib", "zstd")))
+_register(ConfigVar(
+    "columnar_compression_level", 3,
+    "Codec level (ref: columnar.compression_level).",
+    int, min_value=1, max_value=19))
+
+# --- ingest ---------------------------------------------------------------
+_register(ConfigVar(
+    "copy_batch_rows", 65_536,
+    "Rows parsed per ingest batch before routing "
+    "(analogue of per-shard COPY buffering, commands/multi_copy.c).",
+    int, min_value=1024, max_value=4_000_000))
+_register(ConfigVar(
+    "enable_binary_protocol", True,
+    "Use binary (numpy) interchange between host stages instead of text "
+    "(ref: citus.enable_binary_protocol, shared_library_init.c:1342).",
+    bool))
+
+# --- transactions / maintenance ------------------------------------------
+_register(ConfigVar(
+    "recover_2pc_interval_ms", 60_000,
+    "How often the maintenance loop retries unresolved prepared commits "
+    "(ref: citus.recover_2pc_interval, shared_library_init.c:2510).",
+    int, min_value=-1, max_value=7_200_000))
+_register(ConfigVar(
+    "max_background_task_executors", 4,
+    "Parallel background tasks (ref: citus.max_background_task_executors).",
+    int, min_value=1, max_value=1000))
+_register(ConfigVar(
+    "defer_shard_delete_interval_ms", 15_000,
+    "Deferred cleanup sweep interval (ref: citus.defer_shard_delete_interval).",
+    int, min_value=-1, max_value=86_400_000))
+
+# --- rebalancer (ref: shard_rebalancer.c + pg_dist_rebalance_strategy) ----
+_register(ConfigVar(
+    "rebalance_threshold", 0.1,
+    "Utilization imbalance tolerated before a move is planned "
+    "(ref default 10%, distributed/README.md:2455-2570).",
+    float, min_value=0.0, max_value=1.0))
+_register(ConfigVar(
+    "rebalance_improvement_threshold", 0.5,
+    "Minimum relative improvement for a move to be worth it (ref 50%).",
+    float, min_value=0.0, max_value=1.0))
+
+# --- planner --------------------------------------------------------------
+_register(ConfigVar(
+    "enable_fast_path_router_planner", True,
+    "Enable the single-shard fast path "
+    "(ref: citus.enable_fast_path_router_planner).", bool))
+_register(ConfigVar(
+    "limit_clause_row_fetch_count", -1,
+    "Rows workers return for unpushable LIMITs (ref same name).",
+    int, min_value=-1, max_value=2**31 - 1))
+_register(ConfigVar(
+    "log_distributed_plans", False,
+    "Debug-log every distributed plan chosen (ref: citus.log_multi_join_order "
+    "/ explain_all_tasks family).", bool))
+
+
+class Settings:
+    """Session-scoped mutable settings over the global registry."""
+
+    def __init__(self, overrides: dict[str, Any] | None = None):
+        self._values: dict[str, Any] = {}
+        for name, value in (overrides or {}).items():
+            self.set(name, value)
+
+    def get(self, name: str) -> Any:
+        if name in self._values:
+            return self._values[name]
+        var = _REGISTRY.get(name)
+        if var is None:
+            raise ConfigError(f"unrecognized configuration parameter {name!r}")
+        return var.default
+
+    def set(self, name: str, value: Any) -> None:
+        var = _REGISTRY.get(name)
+        if var is None:
+            raise ConfigError(f"unrecognized configuration parameter {name!r}")
+        if var.vartype is bool:
+            if isinstance(value, str):
+                lowered = value.strip().lower()
+                if lowered in ("on", "true", "1", "yes"):
+                    value = True
+                elif lowered in ("off", "false", "0", "no"):
+                    value = False
+                else:
+                    raise ConfigError(
+                        f"{name}: invalid boolean value {value!r}")
+            value = bool(value)
+        elif var.vartype is int:
+            value = int(value)
+        elif var.vartype is float:
+            value = float(value)
+        elif var.vartype is str:
+            value = str(value)
+        if var.min_value is not None and value < var.min_value:
+            raise ConfigError(f"{name}: {value} below minimum {var.min_value}")
+        if var.max_value is not None and value > var.max_value:
+            raise ConfigError(f"{name}: {value} above maximum {var.max_value}")
+        if var.choices is not None and value not in var.choices:
+            raise ConfigError(f"{name}: invalid value {value!r}; choose from {var.choices}")
+        if var.validate is not None:
+            var.validate(value)
+        self._values[name] = value
+
+    def reset(self, name: str) -> None:
+        self._values.pop(name, None)
+
+    def show_all(self) -> dict[str, Any]:
+        return {name: self.get(name) for name in sorted(_REGISTRY)}
+
+    @contextlib.contextmanager
+    def override(self, **kwargs):
+        saved = dict(self._values)
+        try:
+            for k, v in kwargs.items():
+                self.set(k, v)
+            yield self
+        finally:
+            self._values = saved

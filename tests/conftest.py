@@ -1,0 +1,27 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+Mirrors the reference's pg_regress_multi.pl trick
+(/root/reference/src/test/regress/pg_regress_multi.pl) of booting a multi-node
+cluster on one machine: here the "cluster" is 8 virtual XLA CPU devices.
+Must run before jax is imported anywhere.
+"""
+
+import os
+
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=8")
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("JAX_ENABLE_X64", "true")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)
+
+
+@pytest.fixture
+def tmp_data_dir(tmp_path):
+    return str(tmp_path / "data")
