@@ -20,7 +20,6 @@ from __future__ import annotations
 
 import enum
 import json
-import os
 import threading
 from dataclasses import dataclass
 from typing import Iterable
@@ -414,19 +413,10 @@ class Catalog:
         return cat
 
     def save(self, path: str) -> None:
-        """Atomic write (tmp + rename) — the catalog's durability primitive."""
-        tmp = path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(self.to_json(), f, indent=1)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, path)
-        # fsync the directory so the rename itself is durable
-        dir_fd = os.open(os.path.dirname(os.path.abspath(path)), os.O_RDONLY)
-        try:
-            os.fsync(dir_fd)
-        finally:
-            os.close(dir_fd)
+        """Atomic durable write — the catalog's durability primitive."""
+        from ..utils.io import atomic_write_json
+
+        atomic_write_json(path, self.to_json())
 
     @staticmethod
     def load(path: str) -> "Catalog":

@@ -40,12 +40,13 @@ def shard_interval_bounds(shard_count: int) -> list[tuple[int, int]]:
 
 def fmix32(x: np.ndarray) -> np.ndarray:
     """murmur3 32-bit finalizer over uint32 (vectorized, numpy host side)."""
-    x = np.asarray(x, dtype=np.uint32).copy()
-    x ^= x >> 16
-    x = (x * np.uint32(0x85EBCA6B)).astype(np.uint32)
-    x ^= x >> 13
-    x = (x * np.uint32(0xC2B2AE35)).astype(np.uint32)
-    x ^= x >> 16
+    x = np.atleast_1d(np.asarray(x, dtype=np.uint32)).copy()
+    with np.errstate(over="ignore"):  # uint32 wraparound is the algorithm
+        x ^= x >> 16
+        x = (x * np.uint32(0x85EBCA6B)).astype(np.uint32)
+        x ^= x >> 13
+        x = (x * np.uint32(0xC2B2AE35)).astype(np.uint32)
+        x ^= x >> 16
     return x
 
 

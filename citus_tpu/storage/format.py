@@ -1,0 +1,259 @@
+"""Binary stripe format: chunked, compressed, min/max-indexed columnar files.
+
+Structural analogue of the reference's columnar serialization
+(/root/reference/src/backend/columnar/columnar_writer.c:252 SerializeChunkData,
+:293 FlushStripe; reader: columnar_reader.c:839 DeserializeChunkData) and its
+skip-node metadata (src/include/columnar/columnar.h:85-111
+ColumnChunkSkipNode: min/max, offsets, compressed sizes).
+
+Key differences, driven by the TPU target:
+
+* The reference maps stripes onto PostgreSQL pages through a logical-offset
+  storage layer (columnar_storage.c) so they ride WAL/replication.  Here a
+  stripe is a self-contained file (footer-at-end, ORC/Parquet style); + the
+  manifest in table_store.py provides atomic visibility (the columnar.stripe
+  catalog analogue).
+* Values are fixed-width little-endian numpy buffers (strings are dict
+  codes), so a decompressed chunk IS the device-ready array — no per-row
+  datum materialization loop (reference hot loop, SURVEY §3.4).
+
+Layout::
+
+    [magic "CTPS1\\0"][u16 version]
+    [compressed buffers ... (values + validity bitmap per column-chunk)]
+    [zlib-compressed JSON footer]
+    [u32 footer_clen][u32 footer_rlen][magic "CTPSEND\\0"]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import zlib
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..errors import StorageError
+from ..types import DataType
+from . import compression
+
+MAGIC = b"CTPS1\x00"
+END_MAGIC = b"CTPSEND\x00"
+VERSION = 1
+
+
+@dataclass(frozen=True)
+class ChunkStats:
+    """Skip-node statistics for one (column, chunk)."""
+
+    min_value: float | int | None
+    max_value: float | int | None
+    null_count: int
+
+
+def _stats_for(values: np.ndarray, valid: np.ndarray, dtype: DataType) -> ChunkStats:
+    null_count = int((~valid).sum())
+    if dtype == DataType.STRING or null_count == len(values):
+        # code ordering is insertion order — min/max not meaningful
+        return ChunkStats(None, None, null_count)
+    vv = values[valid]
+    if dtype == DataType.BOOL:
+        return ChunkStats(int(vv.min()), int(vv.max()), null_count)
+    mn, mx = vv.min(), vv.max()
+    if dtype in (DataType.FLOAT32, DataType.FLOAT64):
+        if np.isnan(mn) or np.isnan(mx):
+            return ChunkStats(None, None, null_count)
+        return ChunkStats(float(mn), float(mx), null_count)
+    return ChunkStats(int(mn), int(mx), null_count)
+
+
+def write_stripe(path: str,
+                 schema_cols: list[tuple[str, DataType]],
+                 columns: dict[str, np.ndarray],
+                 validity: dict[str, np.ndarray] | None = None,
+                 codec: str = "zstd",
+                 level: int = 3,
+                 chunk_rows: int = 10_000) -> dict:
+    """Write one stripe; returns the footer dict (for manifest bookkeeping)."""
+    if not schema_cols:
+        raise StorageError("stripe needs at least one column")
+    validity = validity or {}
+    n = None
+    for name, _ in schema_cols:
+        if name not in columns:
+            raise StorageError(f"missing column {name!r}")
+        if n is None:
+            n = len(columns[name])
+        elif len(columns[name]) != n:
+            raise StorageError("column length mismatch")
+    if n == 0:
+        raise StorageError("empty stripe")
+    cid = compression.codec_id(codec)
+
+    chunk_bounds = [(i, min(i + chunk_rows, n)) for i in range(0, n, chunk_rows)]
+    footer: dict = {
+        "version": VERSION,
+        "row_count": n,
+        "codec": cid,
+        "chunk_rows": [hi - lo for lo, hi in chunk_bounds],
+        "columns": [],
+    }
+
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(MAGIC)
+        f.write(np.uint16(VERSION).tobytes())
+        for name, dtype in schema_cols:
+            arr = np.ascontiguousarray(
+                columns[name], dtype=dtype.numpy_dtype)
+            valid = validity.get(name)
+            if valid is None:
+                valid = np.ones(n, dtype=np.bool_)
+            else:
+                valid = np.asarray(valid, dtype=np.bool_)
+                if len(valid) != n:
+                    raise StorageError("validity length mismatch")
+            col_meta = {"name": name, "dtype": dtype.value, "chunks": []}
+            for lo, hi in chunk_bounds:
+                cvals, cvalid = arr[lo:hi], valid[lo:hi]
+                stats = _stats_for(cvals, cvalid, dtype)
+                raw_v = cvals.tobytes()
+                comp_v = compression.compress(raw_v, cid, level)
+                voff = f.tell()
+                f.write(comp_v)
+                if stats.null_count:
+                    raw_n = np.packbits(cvalid).tobytes()
+                    comp_n = compression.compress(raw_n, cid, level)
+                    noff, nclen, nrlen = f.tell(), len(comp_n), len(raw_n)
+                    f.write(comp_n)
+                else:
+                    noff = nclen = nrlen = 0  # all-valid: bitmap elided
+                col_meta["chunks"].append({
+                    "voff": voff, "vclen": len(comp_v), "vrlen": len(raw_v),
+                    "noff": noff, "nclen": nclen, "nrlen": nrlen,
+                    "min": stats.min_value, "max": stats.max_value,
+                    "nulls": stats.null_count,
+                })
+            footer["columns"].append(col_meta)
+        raw_footer = json.dumps(footer).encode("utf-8")
+        comp_footer = zlib.compress(raw_footer, 6)
+        f.write(comp_footer)
+        f.write(np.uint32(len(comp_footer)).tobytes())
+        f.write(np.uint32(len(raw_footer)).tobytes())
+        f.write(END_MAGIC)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return footer
+
+
+def read_stripe_footer(path: str) -> dict:
+    with open(path, "rb") as f:
+        f.seek(0, os.SEEK_END)
+        size = f.tell()
+        tail_len = 4 + 4 + len(END_MAGIC)
+        if size < len(MAGIC) + 2 + tail_len:
+            raise StorageError(f"{path}: truncated stripe file")
+        f.seek(size - tail_len)
+        tail = f.read(tail_len)
+        if tail[8:] != END_MAGIC:
+            raise StorageError(f"{path}: bad end magic (corrupt or partial write)")
+        clen = int(np.frombuffer(tail[0:4], dtype=np.uint32)[0])
+        rlen = int(np.frombuffer(tail[4:8], dtype=np.uint32)[0])
+        f.seek(size - tail_len - clen)
+        raw = zlib.decompress(f.read(clen))
+        if len(raw) != rlen:
+            raise StorageError(f"{path}: footer length mismatch")
+        f.seek(0)
+        if f.read(len(MAGIC)) != MAGIC:
+            raise StorageError(f"{path}: bad magic")
+    return json.loads(raw)
+
+
+class StripeReader:
+    """Projection + chunk-skipping reader for one stripe file.
+
+    `chunk_filter(stats_by_column) -> bool` receives, per chunk,
+    ``{column: (min, max, null_count)}`` for the *projected* columns and
+    returns False to skip the chunk — the PruneShards/skip-node analogue at
+    chunk granularity (reference: columnar_reader.c chunk-group filtering).
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        self.footer = read_stripe_footer(path)
+        self._by_name = {c["name"]: c for c in self.footer["columns"]}
+
+    @property
+    def row_count(self) -> int:
+        return self.footer["row_count"]
+
+    @property
+    def n_chunks(self) -> int:
+        return len(self.footer["chunk_rows"])
+
+    @property
+    def column_names(self) -> list[str]:
+        return [c["name"] for c in self.footer["columns"]]
+
+    def column_dtype(self, name: str) -> DataType:
+        return DataType(self._by_name[name]["dtype"])
+
+    def chunk_stats(self, chunk_idx: int, columns: list[str]) -> dict:
+        out = {}
+        for name in columns:
+            ch = self._by_name[name]["chunks"][chunk_idx]
+            out[name] = (ch["min"], ch["max"], ch["nulls"])
+        return out
+
+    def selected_chunks(self, columns: list[str], chunk_filter=None) -> list[int]:
+        if chunk_filter is None:
+            return list(range(self.n_chunks))
+        return [i for i in range(self.n_chunks)
+                if chunk_filter(self.chunk_stats(i, columns))]
+
+    def read(self, columns: list[str] | None = None, chunk_filter=None,
+             ) -> tuple[dict[str, np.ndarray], dict[str, np.ndarray], int]:
+        """Read (and concatenate) selected chunks of the projected columns.
+
+        Returns (values, validity, row_count_read).
+        """
+        columns = columns or self.column_names
+        for name in columns:
+            if name not in self._by_name:
+                raise StorageError(f"{self.path}: no column {name!r}")
+        cid = self.footer["codec"]
+        chunks = self.selected_chunks(columns, chunk_filter)
+        values: dict[str, list[np.ndarray]] = {c: [] for c in columns}
+        validity: dict[str, list[np.ndarray]] = {c: [] for c in columns}
+        rows_read = 0
+        with open(self.path, "rb") as f:
+            for i in chunks:
+                nrows = self.footer["chunk_rows"][i]
+                rows_read += nrows
+                for name in columns:
+                    col = self._by_name[name]
+                    ch = col["chunks"][i]
+                    dtype = DataType(col["dtype"])
+                    f.seek(ch["voff"])
+                    raw = compression.decompress(
+                        f.read(ch["vclen"]), cid, ch["vrlen"])
+                    arr = np.frombuffer(raw, dtype=dtype.numpy_dtype)
+                    values[name].append(arr)
+                    if ch["nulls"]:
+                        f.seek(ch["noff"])
+                        rawn = compression.decompress(
+                            f.read(ch["nclen"]), cid, ch["nrlen"])
+                        bits = np.unpackbits(
+                            np.frombuffer(rawn, dtype=np.uint8))[:nrows]
+                        validity[name].append(bits.astype(np.bool_))
+                    else:
+                        validity[name].append(np.ones(nrows, dtype=np.bool_))
+        out_v = {c: (np.concatenate(values[c]) if values[c]
+                     else np.empty(0, dtype=self.column_dtype(c).numpy_dtype))
+                 for c in columns}
+        out_m = {c: (np.concatenate(validity[c]) if validity[c]
+                     else np.empty(0, dtype=np.bool_))
+                 for c in columns}
+        return out_v, out_m, rows_read

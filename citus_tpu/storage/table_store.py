@@ -1,0 +1,218 @@
+"""Per-shard stripe management: manifests, dictionaries, append/scan.
+
+The reference keeps stripe metadata in catalog tables
+(/root/reference/src/backend/columnar/columnar_metadata.c:171-181
+columnar.stripe / chunk_group / chunk) with transactional visibility; here
+each table has a MANIFEST.json updated by atomic rename, and the transaction
+layer (citus_tpu.transaction) stages manifests for multi-table atomic ingest
+(the 2PC analogue).
+
+Directory layout::
+
+    <data_dir>/
+      catalog.json
+      tables/<table>/
+        MANIFEST.json
+        dict_<column>.json
+        shard_<shard_id>/stripe_<n>.ctps
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+
+import numpy as np
+
+from ..catalog import Catalog
+from ..utils.io import atomic_write_json
+from .dictionary import Dictionary
+from .format import StripeReader, write_stripe
+
+
+class TableStore:
+    """Host-side storage manager for all tables under one data directory."""
+
+    def __init__(self, data_dir: str, catalog: Catalog):
+        self.data_dir = data_dir
+        self.catalog = catalog
+        self._lock = threading.RLock()
+        self._manifests: dict[str, dict] = {}
+        self._dicts: dict[tuple[str, str], Dictionary] = {}
+        os.makedirs(os.path.join(data_dir, "tables"), exist_ok=True)
+
+    # -- paths -------------------------------------------------------------
+    def table_dir(self, table: str) -> str:
+        return os.path.join(self.data_dir, "tables", table)
+
+    def shard_dir(self, table: str, shard_id: int) -> str:
+        return os.path.join(self.table_dir(table), f"shard_{shard_id}")
+
+    def _manifest_path(self, table: str) -> str:
+        return os.path.join(self.table_dir(table), "MANIFEST.json")
+
+    # -- manifest ----------------------------------------------------------
+    def manifest(self, table: str) -> dict:
+        with self._lock:
+            if table not in self._manifests:
+                path = self._manifest_path(table)
+                if os.path.exists(path):
+                    with open(path) as f:
+                        self._manifests[table] = json.load(f)
+                else:
+                    self._manifests[table] = {"next_stripe": 1, "shards": {}}
+            return self._manifests[table]
+
+    def _save_manifest(self, table: str) -> None:
+        os.makedirs(self.table_dir(table), exist_ok=True)
+        atomic_write_json(self._manifest_path(table), self._manifests[table])
+
+    def drop_table_storage(self, table: str) -> None:
+        import shutil
+
+        with self._lock:
+            self._manifests.pop(table, None)
+            self._dicts = {k: v for k, v in self._dicts.items() if k[0] != table}
+            if os.path.exists(self.table_dir(table)):
+                shutil.rmtree(self.table_dir(table))
+
+    # -- dictionaries ------------------------------------------------------
+    def dictionary(self, table: str, column: str) -> Dictionary:
+        with self._lock:
+            key = (table, column)
+            if key not in self._dicts:
+                path = os.path.join(self.table_dir(table), f"dict_{column}.json")
+                self._dicts[key] = (Dictionary.load(path)
+                                    if os.path.exists(path) else Dictionary())
+            return self._dicts[key]
+
+    def save_dictionaries(self, table: str) -> None:
+        with self._lock:
+            os.makedirs(self.table_dir(table), exist_ok=True)
+            for (t, col), d in self._dicts.items():
+                if t == table:
+                    d.save(os.path.join(self.table_dir(table), f"dict_{col}.json"))
+
+    # -- write path --------------------------------------------------------
+    def append_stripe(self, table: str, shard_id: int,
+                      columns: dict[str, np.ndarray],
+                      validity: dict[str, np.ndarray] | None = None,
+                      codec: str = "zstd", level: int = 3,
+                      chunk_rows: int = 10_000,
+                      commit: bool = True) -> dict:
+        """Write one stripe for a shard.  With commit=False the stripe file
+        exists on disk but is invisible until `commit_pending` flips the
+        manifest — the write/visibility split the transaction layer uses.
+        Returns the pending-stripe record."""
+        meta = self.catalog.table(table)
+        schema_cols = [(c.name, c.dtype) for c in meta.schema.columns]
+        with self._lock:
+            # Persist the bumped counter BEFORE writing the file so a crash +
+            # reopen can never re-allocate (and overwrite) this stripe number.
+            man = self.manifest(table)
+            stripe_no = man["next_stripe"]
+            man["next_stripe"] = stripe_no + 1
+            self._save_manifest(table)
+            os.makedirs(self.shard_dir(table, shard_id), exist_ok=True)
+            fname = f"stripe_{stripe_no:06d}.ctps"
+            path = os.path.join(self.shard_dir(table, shard_id), fname)
+        # stripe write (compression + fsync) happens outside the store lock
+        footer = write_stripe(path, schema_cols, columns, validity,
+                              codec=codec, level=level, chunk_rows=chunk_rows)
+        record = {"file": fname, "rows": footer["row_count"],
+                  "bytes": os.path.getsize(path)}
+        if commit:
+            self.commit_pending(table, [(shard_id, record)])
+        return record
+
+    def commit_pending(self, table: str,
+                       pending: list[tuple[int, dict]]) -> None:
+        """Atomically make a batch of stripes visible: one manifest write.
+
+        Dictionaries are persisted first so a committed STRING stripe can
+        never reference codes missing from the on-disk dictionary (the
+        dictionary is append-only, so over-persisting is harmless)."""
+        with self._lock:
+            self.save_dictionaries(table)
+            man = self.manifest(table)
+            for shard_id, record in pending:
+                man["shards"].setdefault(str(shard_id), []).append(record)
+                stripe_no = int(record["file"].split("_")[1].split(".")[0])
+                man["next_stripe"] = max(man["next_stripe"], stripe_no + 1)
+            self._save_manifest(table)
+
+    def discard_pending(self, table: str,
+                        pending: list[tuple[int, dict]]) -> None:
+        with self._lock:
+            for shard_id, record in pending:
+                path = os.path.join(self.shard_dir(table, shard_id),
+                                    record["file"])
+                if os.path.exists(path):
+                    os.unlink(path)
+
+    # -- read path ---------------------------------------------------------
+    def shard_stripe_paths(self, table: str, shard_id: int) -> list[str]:
+        man = self.manifest(table)
+        records = man["shards"].get(str(shard_id), [])
+        return [os.path.join(self.shard_dir(table, shard_id), r["file"])
+                for r in records]
+
+    def shard_row_count(self, table: str, shard_id: int) -> int:
+        man = self.manifest(table)
+        return sum(r["rows"] for r in man["shards"].get(str(shard_id), []))
+
+    def shard_size_bytes(self, table: str, shard_id: int) -> int:
+        man = self.manifest(table)
+        return sum(r["bytes"] for r in man["shards"].get(str(shard_id), []))
+
+    def table_row_count(self, table: str) -> int:
+        man = self.manifest(table)
+        return sum(r["rows"] for recs in man["shards"].values() for r in recs)
+
+    def read_shard(self, table: str, shard_id: int,
+                   columns: list[str] | None = None, chunk_filter=None,
+                   ) -> tuple[dict[str, np.ndarray], dict[str, np.ndarray], int]:
+        """Concatenate all visible stripes of one shard (projected)."""
+        meta = self.catalog.table(table)
+        columns = columns or meta.schema.names
+        paths = self.shard_stripe_paths(table, shard_id)
+        vals: dict[str, list[np.ndarray]] = {c: [] for c in columns}
+        mask: dict[str, list[np.ndarray]] = {c: [] for c in columns}
+        total = 0
+        for p in paths:
+            v, m, n = StripeReader(p).read(columns, chunk_filter)
+            total += n
+            for c in columns:
+                vals[c].append(v[c])
+                mask[c].append(m[c])
+        out_v = {}
+        out_m = {}
+        for c in columns:
+            dtype = meta.schema.column(c).dtype
+            out_v[c] = (np.concatenate(vals[c]) if vals[c]
+                        else np.empty(0, dtype=dtype.numpy_dtype))
+            out_m[c] = (np.concatenate(mask[c]) if mask[c]
+                        else np.empty(0, dtype=np.bool_))
+        return out_v, out_m, total
+
+    def move_shard_storage(self, table: str, shard_id: int,
+                           dest_store: "TableStore") -> int:
+        """Copy a shard's stripe files + manifest records into another store
+        (the data plane of shard moves; ref: operations/worker_shard_copy.c).
+        Returns rows moved.  Catalog placement updates are the caller's job."""
+        import shutil
+
+        paths = self.shard_stripe_paths(table, shard_id)
+        man = self.manifest(table)
+        records = man["shards"].get(str(shard_id), [])
+        os.makedirs(dest_store.shard_dir(table, shard_id), exist_ok=True)
+        for p, rec in zip(paths, records):
+            shutil.copy2(p, os.path.join(
+                dest_store.shard_dir(table, shard_id), rec["file"]))
+        with dest_store._lock:
+            dman = dest_store.manifest(table)
+            dman["shards"][str(shard_id)] = [dict(r) for r in records]
+            dman["next_stripe"] = max(dman["next_stripe"], man["next_stripe"])
+            dest_store._save_manifest(table)
+        return sum(r["rows"] for r in records)

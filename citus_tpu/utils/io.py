@@ -1,0 +1,26 @@
+"""Shared durable-write helpers (single home for the atomic-JSON pattern)."""
+
+from __future__ import annotations
+
+import json
+import os
+
+
+def fsync_dir(path: str) -> None:
+    dir_fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(dir_fd)
+    finally:
+        os.close(dir_fd)
+
+
+def atomic_write_json(path: str, obj, indent: int | None = 1) -> None:
+    """tmp + fsync + rename + dir fsync: the durability primitive under the
+    catalog, manifests, and dictionaries."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(obj, f, indent=indent)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    fsync_dir(os.path.dirname(os.path.abspath(path)))
