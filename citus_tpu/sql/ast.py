@@ -1,0 +1,426 @@
+"""SQL abstract syntax tree.
+
+The reference consumes PostgreSQL's parse trees (Query nodes) directly; this
+framework owns its SQL surface, so the AST is defined here.  Node inventory
+is scoped to the query shapes the planner cascade supports (TPC-H-class
+analytics + DDL/COPY/INSERT), per SURVEY.md §7 "SQL surface control".
+
+All nodes are frozen dataclasses: hashable, comparable, safe as plan-cache
+keys (the reference relies on PG plan-cache invariants for the same purpose).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Union
+
+
+class Node:
+    """Marker base class."""
+
+
+# --------------------------------------------------------------------------
+# expressions
+# --------------------------------------------------------------------------
+
+class Expr(Node):
+    pass
+
+
+@dataclass(frozen=True)
+class ColumnRef(Expr):
+    name: str
+    table: Optional[str] = None  # qualifier as written (alias or table)
+
+    def __str__(self):
+        return f"{self.table}.{self.name}" if self.table else self.name
+
+
+@dataclass(frozen=True)
+class Literal(Expr):
+    value: object          # int | float | str | bool | None
+    type_hint: str = ""    # "" | "date" | "interval"
+    interval_unit: str = ""  # day/month/year for intervals
+
+    def __str__(self):
+        if self.type_hint == "date":
+            return f"DATE '{self.value}'"
+        if self.type_hint == "interval":
+            return f"INTERVAL '{self.value}' {self.interval_unit.upper()}"
+        if isinstance(self.value, str):
+            escaped = self.value.replace("'", "''")
+            return f"'{escaped}'"
+        if self.value is None:
+            return "NULL"
+        return str(self.value)
+
+
+@dataclass(frozen=True)
+class Star(Expr):
+    table: Optional[str] = None
+
+    def __str__(self):
+        return f"{self.table}.*" if self.table else "*"
+
+
+@dataclass(frozen=True)
+class BinaryOp(Expr):
+    op: str  # + - * / % = <> < <= > >= AND OR ||
+    left: Expr
+    right: Expr
+
+    def __str__(self):
+        return f"({self.left} {self.op} {self.right})"
+
+
+@dataclass(frozen=True)
+class UnaryOp(Expr):
+    op: str  # NOT, -
+    operand: Expr
+
+    def __str__(self):
+        return f"({self.op} {self.operand})"
+
+
+@dataclass(frozen=True)
+class IsNull(Expr):
+    operand: Expr
+    negated: bool = False
+
+    def __str__(self):
+        return f"({self.operand} IS {'NOT ' if self.negated else ''}NULL)"
+
+
+@dataclass(frozen=True)
+class Between(Expr):
+    operand: Expr
+    low: Expr
+    high: Expr
+    negated: bool = False
+
+    def __str__(self):
+        neg = "NOT " if self.negated else ""
+        return f"({self.operand} {neg}BETWEEN {self.low} AND {self.high})"
+
+
+@dataclass(frozen=True)
+class InList(Expr):
+    operand: Expr
+    items: tuple[Expr, ...]
+    negated: bool = False
+
+    def __str__(self):
+        neg = "NOT " if self.negated else ""
+        return f"({self.operand} {neg}IN ({', '.join(map(str, self.items))}))"
+
+
+@dataclass(frozen=True)
+class Like(Expr):
+    operand: Expr
+    pattern: Expr
+    negated: bool = False
+
+    def __str__(self):
+        neg = "NOT " if self.negated else ""
+        return f"({self.operand} {neg}LIKE {self.pattern})"
+
+
+@dataclass(frozen=True)
+class FuncCall(Expr):
+    name: str                 # lowercased
+    args: tuple[Expr, ...]
+    distinct: bool = False    # count(DISTINCT x)
+    star: bool = False        # count(*)
+
+    def __str__(self):
+        if self.star:
+            return f"{self.name}(*)"
+        d = "DISTINCT " if self.distinct else ""
+        return f"{self.name}({d}{', '.join(map(str, self.args))})"
+
+
+@dataclass(frozen=True)
+class Cast(Expr):
+    operand: Expr
+    type_name: str
+
+    def __str__(self):
+        return f"CAST({self.operand} AS {self.type_name})"
+
+
+@dataclass(frozen=True)
+class Extract(Expr):
+    part: str  # year | month | day
+    operand: Expr
+
+    def __str__(self):
+        return f"EXTRACT({self.part.upper()} FROM {self.operand})"
+
+
+@dataclass(frozen=True)
+class Substring(Expr):
+    operand: Expr
+    start: Expr            # 1-based
+    length: Optional[Expr] = None
+
+    def __str__(self):
+        if self.length is None:
+            return f"SUBSTRING({self.operand} FROM {self.start})"
+        return f"SUBSTRING({self.operand} FROM {self.start} FOR {self.length})"
+
+
+@dataclass(frozen=True)
+class CaseWhen(Expr):
+    whens: tuple[tuple[Expr, Expr], ...]  # (condition, result)
+    else_result: Optional[Expr] = None
+
+    def __str__(self):
+        parts = " ".join(f"WHEN {c} THEN {r}" for c, r in self.whens)
+        els = f" ELSE {self.else_result}" if self.else_result is not None else ""
+        return f"CASE {parts}{els} END"
+
+
+@dataclass(frozen=True)
+class ScalarSubquery(Expr):
+    query: "Select"
+
+    def __str__(self):
+        return "(<subquery>)"
+
+
+@dataclass(frozen=True)
+class InSubquery(Expr):
+    operand: Expr
+    query: "Select"
+    negated: bool = False
+
+    def __str__(self):
+        neg = "NOT " if self.negated else ""
+        return f"({self.operand} {neg}IN (<subquery>))"
+
+
+@dataclass(frozen=True)
+class Exists(Expr):
+    query: "Select"
+    negated: bool = False
+
+    def __str__(self):
+        neg = "NOT " if self.negated else ""
+        return f"{neg}EXISTS (<subquery>)"
+
+
+AGGREGATE_FUNCS = frozenset({"count", "sum", "avg", "min", "max"})
+
+
+def is_aggregate_call(e: Expr) -> bool:
+    return isinstance(e, FuncCall) and e.name in AGGREGATE_FUNCS
+
+
+def contains_aggregate(e: Expr) -> bool:
+    if is_aggregate_call(e):
+        return True
+    return any(contains_aggregate(c) for c in expr_children(e))
+
+
+def expr_children(e: Expr) -> tuple[Expr, ...]:
+    if isinstance(e, BinaryOp):
+        return (e.left, e.right)
+    if isinstance(e, UnaryOp):
+        return (e.operand,)
+    if isinstance(e, IsNull):
+        return (e.operand,)
+    if isinstance(e, Between):
+        return (e.operand, e.low, e.high)
+    if isinstance(e, InList):
+        return (e.operand,) + e.items
+    if isinstance(e, Like):
+        return (e.operand, e.pattern)
+    if isinstance(e, FuncCall):
+        return e.args
+    if isinstance(e, Cast):
+        return (e.operand,)
+    if isinstance(e, Extract):
+        return (e.operand,)
+    if isinstance(e, Substring):
+        return ((e.operand, e.start) +
+                ((e.length,) if e.length is not None else ()))
+    if isinstance(e, CaseWhen):
+        out: tuple[Expr, ...] = ()
+        for c, r in e.whens:
+            out += (c, r)
+        if e.else_result is not None:
+            out += (e.else_result,)
+        return out
+    if isinstance(e, InSubquery):
+        return (e.operand,)
+    return ()
+
+
+def walk_expr(e: Expr):
+    yield e
+    for c in expr_children(e):
+        yield from walk_expr(c)
+
+
+def collect_column_refs(e: Expr) -> list[ColumnRef]:
+    return [n for n in walk_expr(e) if isinstance(n, ColumnRef)]
+
+
+# --------------------------------------------------------------------------
+# FROM items / joins
+# --------------------------------------------------------------------------
+
+class FromItem(Node):
+    pass
+
+
+@dataclass(frozen=True)
+class TableRef(FromItem):
+    name: str
+    alias: Optional[str] = None
+
+    @property
+    def output_name(self) -> str:
+        return self.alias or self.name
+
+    def __str__(self):
+        return f"{self.name} {self.alias}" if self.alias else self.name
+
+
+@dataclass(frozen=True)
+class SubqueryRef(FromItem):
+    query: "Select"
+    alias: str
+
+    @property
+    def output_name(self) -> str:
+        return self.alias
+
+    def __str__(self):
+        return f"(<subquery>) {self.alias}"
+
+
+@dataclass(frozen=True)
+class Join(FromItem):
+    join_type: str  # inner | left | right | full | cross
+    left: FromItem
+    right: FromItem
+    condition: Optional[Expr] = None   # ON clause; None for cross/USING
+    using_cols: tuple[str, ...] = ()   # USING (...) — expanded by the binder
+
+    def __str__(self):
+        if self.using_cols:
+            return (f"({self.left} {self.join_type.upper()} JOIN "
+                    f"{self.right} USING ({', '.join(self.using_cols)}))")
+        cond = f" ON {self.condition}" if self.condition is not None else ""
+        return f"({self.left} {self.join_type.upper()} JOIN {self.right}{cond})"
+
+
+# --------------------------------------------------------------------------
+# statements
+# --------------------------------------------------------------------------
+
+class Statement(Node):
+    pass
+
+
+@dataclass(frozen=True)
+class SelectItem(Node):
+    expr: Expr
+    alias: Optional[str] = None
+
+    def __str__(self):
+        return f"{self.expr} AS {self.alias}" if self.alias else str(self.expr)
+
+
+@dataclass(frozen=True)
+class OrderItem(Node):
+    expr: Expr
+    descending: bool = False
+    nulls_first: Optional[bool] = None
+
+    def __str__(self):
+        return f"{self.expr} {'DESC' if self.descending else 'ASC'}"
+
+
+@dataclass(frozen=True)
+class CommonTableExpr(Node):
+    name: str
+    query: "Select"
+    column_names: tuple[str, ...] = ()
+
+
+@dataclass(frozen=True)
+class Select(Statement):
+    items: tuple[SelectItem, ...]
+    from_items: tuple[FromItem, ...] = ()   # comma-separated = implicit cross
+    where: Optional[Expr] = None
+    group_by: tuple[Expr, ...] = ()
+    having: Optional[Expr] = None
+    order_by: tuple[OrderItem, ...] = ()
+    limit: Optional[int] = None
+    offset: Optional[int] = None
+    distinct: bool = False
+    ctes: tuple[CommonTableExpr, ...] = ()
+
+
+@dataclass(frozen=True)
+class ColumnSpec(Node):
+    name: str
+    type_name: str
+    not_null: bool = False
+
+
+@dataclass(frozen=True)
+class CreateTable(Statement):
+    name: str
+    columns: tuple[ColumnSpec, ...]
+    if_not_exists: bool = False
+
+
+@dataclass(frozen=True)
+class DropTable(Statement):
+    name: str
+    if_exists: bool = False
+
+
+@dataclass(frozen=True)
+class InsertValues(Statement):
+    table: str
+    columns: tuple[str, ...]          # empty = all, in schema order
+    rows: tuple[tuple[Expr, ...], ...]
+
+
+@dataclass(frozen=True)
+class InsertSelect(Statement):
+    table: str
+    columns: tuple[str, ...]
+    query: Select
+
+
+@dataclass(frozen=True)
+class CopyFrom(Statement):
+    table: str
+    path: str
+    format: str = "csv"     # csv | text(tbl)
+    delimiter: str = ","
+    header: bool = False
+    null_string: str = ""
+
+
+@dataclass(frozen=True)
+class Explain(Statement):
+    statement: Statement
+    analyze: bool = False
+    verbose: bool = False
+
+
+@dataclass(frozen=True)
+class SetVariable(Statement):
+    name: str
+    value: object
+
+
+@dataclass(frozen=True)
+class ShowVariable(Statement):
+    name: str  # or "all"

@@ -1,0 +1,673 @@
+"""Recursive-descent SQL parser.
+
+Grammar scope: the analytic SQL surface the planner cascade supports
+(SURVEY.md §7 — TPC-H-class SELECTs with joins/subqueries/CTEs, plus DDL,
+INSERT, COPY, EXPLAIN, SET/SHOW).  Unsupported constructs raise ParseError
+with position info.
+"""
+
+from __future__ import annotations
+
+from ..errors import ParseError
+from . import ast
+from .lexer import Token, tokenize
+
+
+def parse(sql: str) -> list[ast.Statement]:
+    """Parse a semicolon-separated script into statements."""
+    return Parser(tokenize(sql)).parse_script()
+
+
+def parse_one(sql: str) -> ast.Statement:
+    stmts = parse(sql)
+    if len(stmts) != 1:
+        raise ParseError(f"expected exactly one statement, got {len(stmts)}")
+    return stmts[0]
+
+
+class Parser:
+    def __init__(self, tokens: list[Token]):
+        self.tokens = tokens
+        self.pos = 0
+
+    # -- token helpers -----------------------------------------------------
+    @property
+    def cur(self) -> Token:
+        return self.tokens[self.pos]
+
+    def peek(self, ahead: int = 1) -> Token:
+        return self.tokens[min(self.pos + ahead, len(self.tokens) - 1)]
+
+    def advance(self) -> Token:
+        tok = self.cur
+        if tok.kind != "eof":
+            self.pos += 1
+        return tok
+
+    def error(self, msg: str):
+        tok = self.cur
+        raise ParseError(f"{msg} near {tok.value!r}" if tok.value else msg,
+                         tok.line, tok.column)
+
+    def at_keyword(self, *words: str) -> bool:
+        return self.cur.kind == "keyword" and self.cur.value in words
+
+    def accept_keyword(self, *words: str) -> bool:
+        if self.at_keyword(*words):
+            self.advance()
+            return True
+        return False
+
+    def expect_keyword(self, word: str) -> None:
+        if not self.accept_keyword(word):
+            self.error(f"expected {word.upper()}")
+
+    def at_op(self, *ops: str) -> bool:
+        return self.cur.kind == "op" and self.cur.value in ops
+
+    def accept_op(self, *ops: str) -> bool:
+        if self.at_op(*ops):
+            self.advance()
+            return True
+        return False
+
+    def expect_op(self, op: str) -> None:
+        if not self.accept_op(op):
+            self.error(f"expected {op!r}")
+
+    def expect_ident(self) -> str:
+        if self.cur.kind == "ident":
+            return self.advance().value
+        # allow non-reserved-ish keywords as identifiers where unambiguous
+        if self.cur.kind == "keyword" and self.cur.value in (
+                "date", "text", "format", "header", "first", "last", "values"):
+            return self.advance().value
+        self.error("expected identifier")
+
+    # -- script ------------------------------------------------------------
+    def parse_script(self) -> list[ast.Statement]:
+        stmts = []
+        while self.cur.kind != "eof":
+            if self.accept_op(";"):
+                continue
+            stmts.append(self.parse_statement())
+            if self.cur.kind != "eof":
+                self.expect_op(";")
+        return stmts
+
+    def parse_statement(self) -> ast.Statement:
+        if self.at_keyword("select", "with"):
+            return self.parse_select()
+        if self.at_keyword("create"):
+            return self.parse_create_table()
+        if self.at_keyword("drop"):
+            return self.parse_drop_table()
+        if self.at_keyword("insert"):
+            return self.parse_insert()
+        if self.at_keyword("copy"):
+            return self.parse_copy()
+        if self.at_keyword("explain"):
+            return self.parse_explain()
+        if self.at_keyword("set"):
+            return self.parse_set()
+        if self.at_keyword("show"):
+            return self.parse_show()
+        self.error("expected a statement")
+
+    # -- SELECT ------------------------------------------------------------
+    def parse_select(self) -> ast.Select:
+        ctes: list[ast.CommonTableExpr] = []
+        if self.accept_keyword("with"):
+            while True:
+                name = self.expect_ident()
+                col_names: tuple[str, ...] = ()
+                if self.accept_op("("):
+                    cols = [self.expect_ident()]
+                    while self.accept_op(","):
+                        cols.append(self.expect_ident())
+                    self.expect_op(")")
+                    col_names = tuple(cols)
+                self.expect_keyword("as")
+                self.expect_op("(")
+                sub = self.parse_select()
+                self.expect_op(")")
+                ctes.append(ast.CommonTableExpr(name, sub, col_names))
+                if not self.accept_op(","):
+                    break
+        self.expect_keyword("select")
+        distinct = False
+        if self.accept_keyword("distinct"):
+            distinct = True
+        elif self.accept_keyword("all"):
+            pass
+        items = [self.parse_select_item()]
+        while self.accept_op(","):
+            items.append(self.parse_select_item())
+
+        from_items: list[ast.FromItem] = []
+        if self.accept_keyword("from"):
+            from_items.append(self.parse_from_item())
+            while self.accept_op(","):
+                from_items.append(self.parse_from_item())
+
+        where = self.parse_expr() if self.accept_keyword("where") else None
+
+        group_by: list[ast.Expr] = []
+        if self.accept_keyword("group"):
+            self.expect_keyword("by")
+            group_by.append(self.parse_expr())
+            while self.accept_op(","):
+                group_by.append(self.parse_expr())
+
+        having = self.parse_expr() if self.accept_keyword("having") else None
+
+        order_by: list[ast.OrderItem] = []
+        if self.accept_keyword("order"):
+            self.expect_keyword("by")
+            order_by.append(self.parse_order_item())
+            while self.accept_op(","):
+                order_by.append(self.parse_order_item())
+
+        limit = offset = None
+        while self.at_keyword("limit", "offset"):
+            if self.accept_keyword("limit"):
+                if self.accept_keyword("all"):
+                    limit = None
+                else:
+                    limit = self._expect_integer()
+            elif self.accept_keyword("offset"):
+                offset = self._expect_integer()
+
+        return ast.Select(
+            items=tuple(items), from_items=tuple(from_items), where=where,
+            group_by=tuple(group_by), having=having, order_by=tuple(order_by),
+            limit=limit, offset=offset, distinct=distinct, ctes=tuple(ctes))
+
+    def _expect_number(self) -> str:
+        if self.cur.kind != "number":
+            self.error("expected a number")
+        return self.advance().value
+
+    def _expect_integer(self) -> int:
+        if self.cur.kind != "number" or not self.cur.value.isdigit():
+            self.error("expected an integer")
+        return int(self.advance().value)
+
+    def parse_select_item(self) -> ast.SelectItem:
+        if self.at_op("*"):
+            self.advance()
+            return ast.SelectItem(ast.Star())
+        expr = self.parse_expr()
+        alias = None
+        if self.accept_keyword("as"):
+            alias = self.expect_ident()
+        elif self.cur.kind == "ident":
+            alias = self.advance().value
+        return ast.SelectItem(expr, alias)
+
+    def parse_order_item(self) -> ast.OrderItem:
+        expr = self.parse_expr()
+        descending = False
+        if self.accept_keyword("desc"):
+            descending = True
+        elif self.accept_keyword("asc"):
+            pass
+        nulls_first = None
+        if self.accept_keyword("nulls"):
+            if self.accept_keyword("first"):
+                nulls_first = True
+            elif self.accept_keyword("last"):
+                nulls_first = False
+            else:
+                self.error("expected FIRST or LAST")
+        return ast.OrderItem(expr, descending, nulls_first)
+
+    # -- FROM / joins ------------------------------------------------------
+    def parse_from_item(self) -> ast.FromItem:
+        left = self.parse_table_primary()
+        while True:
+            join_type = None
+            if self.accept_keyword("cross"):
+                self.expect_keyword("join")
+                join_type = "cross"
+            elif self.accept_keyword("inner"):
+                self.expect_keyword("join")
+                join_type = "inner"
+            elif self.at_keyword("left", "right", "full"):
+                join_type = self.advance().value
+                self.accept_keyword("outer")
+                self.expect_keyword("join")
+            elif self.accept_keyword("join"):
+                join_type = "inner"
+            if join_type is None:
+                return left
+            right = self.parse_table_primary()
+            condition = None
+            using_cols: tuple[str, ...] = ()
+            if join_type != "cross":
+                if self.accept_keyword("using"):
+                    # schema knowledge is needed to qualify the left side of
+                    # USING; carry the column list and let the planner's
+                    # binder expand it (ast.Join.using_cols)
+                    self.expect_op("(")
+                    cols = [self.expect_ident()]
+                    while self.accept_op(","):
+                        cols.append(self.expect_ident())
+                    self.expect_op(")")
+                    using_cols = tuple(cols)
+                else:
+                    self.expect_keyword("on")
+                    condition = self.parse_expr()
+            left = ast.Join(join_type, left, right, condition, using_cols)
+
+    def parse_table_primary(self) -> ast.FromItem:
+        if self.accept_op("("):
+            if self.at_keyword("select", "with"):
+                sub = self.parse_select()
+                self.expect_op(")")
+                self.accept_keyword("as")
+                alias = self.expect_ident()
+                return ast.SubqueryRef(sub, alias)
+            item = self.parse_from_item()
+            self.expect_op(")")
+            return item
+        name = self.expect_ident()
+        alias = None
+        if self.accept_keyword("as"):
+            alias = self.expect_ident()
+        elif self.cur.kind == "ident":
+            alias = self.advance().value
+        return ast.TableRef(name, alias)
+
+    # -- expressions (precedence climbing) ---------------------------------
+    def parse_expr(self) -> ast.Expr:
+        return self.parse_or()
+
+    def parse_or(self) -> ast.Expr:
+        left = self.parse_and()
+        while self.accept_keyword("or"):
+            left = ast.BinaryOp("OR", left, self.parse_and())
+        return left
+
+    def parse_and(self) -> ast.Expr:
+        left = self.parse_not()
+        while self.accept_keyword("and"):
+            left = ast.BinaryOp("AND", left, self.parse_not())
+        return left
+
+    def parse_not(self) -> ast.Expr:
+        if self.accept_keyword("not"):
+            return ast.UnaryOp("NOT", self.parse_not())
+        return self.parse_comparison()
+
+    def parse_comparison(self) -> ast.Expr:
+        left = self.parse_additive()
+        # IS [NOT] NULL
+        if self.accept_keyword("is"):
+            negated = bool(self.accept_keyword("not"))
+            self.expect_keyword("null")
+            return ast.IsNull(left, negated)
+        negated = False
+        if self.at_keyword("not") and self.peek().kind == "keyword" and \
+                self.peek().value in ("between", "in", "like", "exists"):
+            self.advance()
+            negated = True
+        if self.accept_keyword("between"):
+            low = self.parse_additive()
+            self.expect_keyword("and")
+            high = self.parse_additive()
+            return ast.Between(left, low, high, negated)
+        if self.accept_keyword("in"):
+            self.expect_op("(")
+            if self.at_keyword("select", "with"):
+                sub = self.parse_select()
+                self.expect_op(")")
+                return ast.InSubquery(left, sub, negated)
+            items = [self.parse_expr()]
+            while self.accept_op(","):
+                items.append(self.parse_expr())
+            self.expect_op(")")
+            return ast.InList(left, tuple(items), negated)
+        if self.accept_keyword("like"):
+            return ast.Like(left, self.parse_additive(), negated)
+        if negated:
+            self.error("expected BETWEEN, IN, or LIKE after NOT")
+        if self.cur.kind == "op" and self.cur.value in (
+                "=", "<>", "!=", "<", "<=", ">", ">="):
+            op = self.advance().value
+            if op == "!=":
+                op = "<>"
+            right = self.parse_additive()
+            return ast.BinaryOp(op, left, right)
+        return left
+
+    def parse_additive(self) -> ast.Expr:
+        left = self.parse_multiplicative()
+        while self.at_op("+", "-", "||"):
+            op = self.advance().value
+            left = ast.BinaryOp(op, left, self.parse_multiplicative())
+        return left
+
+    def parse_multiplicative(self) -> ast.Expr:
+        left = self.parse_unary()
+        while self.at_op("*", "/", "%"):
+            op = self.advance().value
+            left = ast.BinaryOp(op, left, self.parse_unary())
+        return left
+
+    def parse_unary(self) -> ast.Expr:
+        if self.accept_op("-"):
+            operand = self.parse_unary()
+            if isinstance(operand, ast.Literal) and isinstance(
+                    operand.value, (int, float)) and not operand.type_hint:
+                return ast.Literal(-operand.value)
+            return ast.UnaryOp("-", operand)
+        if self.accept_op("+"):
+            return self.parse_unary()
+        return self.parse_postfix()
+
+    def parse_postfix(self) -> ast.Expr:
+        expr = self.parse_primary()
+        while self.accept_op("::"):
+            type_name = self._parse_type_name()
+            expr = ast.Cast(expr, type_name)
+        return expr
+
+    def _parse_type_name(self) -> str:
+        parts = [self.expect_ident() if self.cur.kind == "ident"
+                 else self.advance().value]
+        # double precision / character varying
+        if parts[0] in ("double", "character") and self.cur.kind in (
+                "ident", "keyword"):
+            if self.cur.value in ("precision", "varying"):
+                parts.append(self.advance().value)
+        name = " ".join(parts)
+        if self.accept_op("("):
+            mods = [self._expect_number()]
+            while self.accept_op(","):
+                mods.append(self._expect_number())
+            self.expect_op(")")
+            name += f"({','.join(mods)})"
+        return name
+
+    def parse_primary(self) -> ast.Expr:
+        tok = self.cur
+        if tok.kind == "number":
+            self.advance()
+            if "." in tok.value or "e" in tok.value or "E" in tok.value:
+                return ast.Literal(float(tok.value))
+            return ast.Literal(int(tok.value))
+        if tok.kind == "string":
+            self.advance()
+            return ast.Literal(tok.value)
+        if self.accept_keyword("true"):
+            return ast.Literal(True)
+        if self.accept_keyword("false"):
+            return ast.Literal(False)
+        if self.accept_keyword("null"):
+            return ast.Literal(None)
+        if self.accept_keyword("date"):
+            if self.cur.kind == "string":
+                return ast.Literal(self.advance().value, type_hint="date")
+            # "date" used as identifier (column named date) — fall through
+            return ast.ColumnRef("date")
+        if self.accept_keyword("interval"):
+            if self.cur.kind != "string":
+                self.error("expected string after INTERVAL")
+            text = self.advance().value
+            unit = ""
+            if self.cur.kind in ("ident", "keyword") and self.cur.value in (
+                    "day", "days", "month", "months", "year", "years"):
+                unit = self.advance().value.rstrip("s")
+            else:
+                # unit inside the string: '3 month'
+                parts = text.split()
+                if len(parts) == 2:
+                    text, unit = parts[0], parts[1].rstrip("s")
+            if unit not in ("day", "month", "year"):
+                self.error("unsupported interval unit")
+            try:
+                quantity = int(text)
+            except ValueError:
+                self.error(f"invalid interval quantity {text!r}")
+            return ast.Literal(quantity, type_hint="interval",
+                               interval_unit=unit)
+        if self.accept_keyword("cast"):
+            self.expect_op("(")
+            operand = self.parse_expr()
+            self.expect_keyword("as")
+            type_name = self._parse_type_name()
+            self.expect_op(")")
+            return ast.Cast(operand, type_name)
+        if self.accept_keyword("extract"):
+            self.expect_op("(")
+            part = self.advance().value
+            if part not in ("year", "month", "day"):
+                self.error("unsupported EXTRACT field")
+            self.expect_keyword("from")
+            operand = self.parse_expr()
+            self.expect_op(")")
+            return ast.Extract(part, operand)
+        if self.accept_keyword("substring"):
+            self.expect_op("(")
+            operand = self.parse_expr()
+            if self.accept_keyword("from"):
+                start = self.parse_expr()
+                length = None
+                if self.accept_keyword("for"):
+                    length = self.parse_expr()
+            else:
+                self.expect_op(",")
+                start = self.parse_expr()
+                length = None
+                if self.accept_op(","):
+                    length = self.parse_expr()
+            self.expect_op(")")
+            return ast.Substring(operand, start, length)
+        if self.accept_keyword("case"):
+            whens = []
+            while self.accept_keyword("when"):
+                cond = self.parse_expr()
+                self.expect_keyword("then")
+                result = self.parse_expr()
+                whens.append((cond, result))
+            else_result = None
+            if self.accept_keyword("else"):
+                else_result = self.parse_expr()
+            self.expect_keyword("end")
+            if not whens:
+                self.error("CASE needs at least one WHEN")
+            return ast.CaseWhen(tuple(whens), else_result)
+        if self.accept_keyword("exists"):
+            self.expect_op("(")
+            sub = self.parse_select()
+            self.expect_op(")")
+            return ast.Exists(sub)
+        if self.accept_op("("):
+            if self.at_keyword("select", "with"):
+                sub = self.parse_select()
+                self.expect_op(")")
+                return ast.ScalarSubquery(sub)
+            expr = self.parse_expr()
+            self.expect_op(")")
+            return expr
+        if tok.kind == "ident" or (tok.kind == "keyword" and tok.value in (
+                "left", "right", "values", "format", "text")):
+            name = self.advance().value
+            # function call
+            if self.at_op("("):
+                return self._parse_func_call(name)
+            # qualified reference: t.col or t.*
+            if self.accept_op("."):
+                if self.accept_op("*"):
+                    return ast.Star(table=name)
+                col = self.expect_ident()
+                return ast.ColumnRef(col, table=name)
+            return ast.ColumnRef(name)
+        self.error("expected an expression")
+
+    def _parse_func_call(self, name: str) -> ast.Expr:
+        self.expect_op("(")
+        if self.accept_op("*"):
+            self.expect_op(")")
+            return ast.FuncCall(name.lower(), (), star=True)
+        distinct = bool(self.accept_keyword("distinct"))
+        args: list[ast.Expr] = []
+        if not self.at_op(")"):
+            args.append(self.parse_expr())
+            while self.accept_op(","):
+                args.append(self.parse_expr())
+        self.expect_op(")")
+        return ast.FuncCall(name.lower(), tuple(args), distinct=distinct)
+
+    # -- DDL / DML ---------------------------------------------------------
+    def parse_create_table(self) -> ast.CreateTable:
+        self.expect_keyword("create")
+        self.expect_keyword("table")
+        if_not_exists = False
+        if self.accept_keyword("if"):
+            self.expect_keyword("not")
+            self.expect_keyword("exists")
+            if_not_exists = True
+        name = self.expect_ident()
+        self.expect_op("(")
+        cols = [self._parse_column_spec()]
+        while self.accept_op(","):
+            cols.append(self._parse_column_spec())
+        self.expect_op(")")
+        return ast.CreateTable(name, tuple(cols), if_not_exists)
+
+    def _parse_column_spec(self) -> ast.ColumnSpec:
+        name = self.expect_ident()
+        type_name = self._parse_type_name()
+        not_null = False
+        while True:
+            if self.accept_keyword("not"):
+                self.expect_keyword("null")
+                not_null = True
+            elif self.accept_keyword("null"):
+                pass
+            elif self.cur.kind == "ident" and self.cur.value in (
+                    "primary", "key", "unique"):
+                self.advance()  # constraints recorded nowhere (v1)
+            else:
+                break
+        return ast.ColumnSpec(name, type_name, not_null)
+
+    def parse_drop_table(self) -> ast.DropTable:
+        self.expect_keyword("drop")
+        self.expect_keyword("table")
+        if_exists = False
+        if self.accept_keyword("if"):
+            self.expect_keyword("exists")
+            if_exists = True
+        return ast.DropTable(self.expect_ident(), if_exists)
+
+    def parse_insert(self) -> ast.Statement:
+        self.expect_keyword("insert")
+        self.expect_keyword("into")
+        table = self.expect_ident()
+        columns: tuple[str, ...] = ()
+        if self.accept_op("("):
+            cols = [self.expect_ident()]
+            while self.accept_op(","):
+                cols.append(self.expect_ident())
+            self.expect_op(")")
+            columns = tuple(cols)
+        if self.at_keyword("select", "with"):
+            return ast.InsertSelect(table, columns, self.parse_select())
+        self.expect_keyword("values")
+        rows = []
+        while True:
+            self.expect_op("(")
+            row = [self.parse_expr()]
+            while self.accept_op(","):
+                row.append(self.parse_expr())
+            self.expect_op(")")
+            rows.append(tuple(row))
+            if not self.accept_op(","):
+                break
+        return ast.InsertValues(table, columns, tuple(rows))
+
+    def parse_copy(self) -> ast.CopyFrom:
+        self.expect_keyword("copy")
+        table = self.expect_ident()
+        self.expect_keyword("from")
+        if self.cur.kind != "string":
+            self.error("expected file path string")
+        path = self.advance().value
+        fmt, delim, header, null_s = "csv", ",", False, ""
+        if self.accept_keyword("with"):
+            self.expect_op("(")
+            while True:
+                opt = self.advance().value
+                if opt == "format":
+                    fmt = self.advance().value
+                elif opt == "delimiter":
+                    delim = self.advance().value
+                elif opt == "header":
+                    if self.cur.kind == "keyword" and self.cur.value in (
+                            "true", "false"):
+                        header = self.advance().value == "true"
+                    else:
+                        header = True
+                elif opt == "null":
+                    null_s = self.advance().value
+                else:
+                    self.error(f"unknown COPY option {opt!r}")
+                if not self.accept_op(","):
+                    break
+            self.expect_op(")")
+        return ast.CopyFrom(table, path, fmt, delim, header, null_s)
+
+    def parse_explain(self) -> ast.Explain:
+        self.expect_keyword("explain")
+        analyze = verbose = False
+        if self.accept_op("("):
+            while True:
+                word = self.advance().value
+                if word == "analyze":
+                    analyze = True
+                elif word == "verbose":
+                    verbose = True
+                else:
+                    self.error(f"unknown EXPLAIN option {word!r}")
+                if not self.accept_op(","):
+                    break
+            self.expect_op(")")
+        else:
+            if self.accept_keyword("analyze"):
+                analyze = True
+            if self.accept_keyword("verbose"):
+                verbose = True
+        return ast.Explain(self.parse_statement(), analyze, verbose)
+
+    def parse_set(self) -> ast.SetVariable:
+        self.expect_keyword("set")
+        name = self.expect_ident()
+        # allow citus_tpu.xxx / citus.xxx prefixes
+        while self.accept_op("."):
+            name = self.expect_ident()
+        if not self.accept_op("="):
+            if not (self.cur.kind == "ident" and self.cur.value == "to"):
+                self.error("expected = or TO")
+            self.advance()
+        if self.cur.kind in ("string", "number"):
+            raw = self.advance()
+            value: object = raw.value
+            if raw.kind == "number":
+                value = float(raw.value) if "." in raw.value else int(raw.value)
+        elif self.cur.kind in ("ident", "keyword"):
+            value = self.advance().value
+        else:
+            self.error("expected a value")
+        return ast.SetVariable(name, value)
+
+    def parse_show(self) -> ast.ShowVariable:
+        self.expect_keyword("show")
+        if self.accept_keyword("all"):
+            return ast.ShowVariable("all")
+        name = self.expect_ident()
+        while self.accept_op("."):
+            name = self.expect_ident()
+        return ast.ShowVariable(name)

@@ -1,0 +1,316 @@
+"""SQL frontend tests, including full TPC-H query texts (the target SQL
+surface per BASELINE.md configs)."""
+
+import pytest
+
+from citus_tpu.errors import ParseError
+from citus_tpu.sql import ast, parse, parse_one
+
+TPCH_Q1 = """
+select
+    l_returnflag,
+    l_linestatus,
+    sum(l_quantity) as sum_qty,
+    sum(l_extendedprice) as sum_base_price,
+    sum(l_extendedprice * (1 - l_discount)) as sum_disc_price,
+    sum(l_extendedprice * (1 - l_discount) * (1 + l_tax)) as sum_charge,
+    avg(l_quantity) as avg_qty,
+    avg(l_extendedprice) as avg_price,
+    avg(l_discount) as avg_disc,
+    count(*) as count_order
+from
+    lineitem
+where
+    l_shipdate <= date '1998-12-01' - interval '90' day
+group by
+    l_returnflag,
+    l_linestatus
+order by
+    l_returnflag,
+    l_linestatus
+"""
+
+TPCH_Q3 = """
+select
+    l_orderkey,
+    sum(l_extendedprice * (1 - l_discount)) as revenue,
+    o_orderdate,
+    o_shippriority
+from
+    customer,
+    orders,
+    lineitem
+where
+    c_mktsegment = 'BUILDING'
+    and c_custkey = o_custkey
+    and l_orderkey = o_orderkey
+    and o_orderdate < date '1995-03-15'
+    and l_shipdate > date '1995-03-15'
+group by
+    l_orderkey,
+    o_orderdate,
+    o_shippriority
+order by
+    revenue desc,
+    o_orderdate
+limit 10
+"""
+
+TPCH_Q5 = """
+select
+    n_name,
+    sum(l_extendedprice * (1 - l_discount)) as revenue
+from
+    customer,
+    orders,
+    lineitem,
+    supplier,
+    nation,
+    region
+where
+    c_custkey = o_custkey
+    and l_orderkey = o_orderkey
+    and l_suppkey = s_suppkey
+    and c_nationkey = s_nationkey
+    and s_nationkey = n_nationkey
+    and n_regionkey = r_regionkey
+    and r_name = 'ASIA'
+    and o_orderdate >= date '1994-01-01'
+    and o_orderdate < date '1994-01-01' + interval '1' year
+group by
+    n_name
+order by
+    revenue desc
+"""
+
+
+class TestLexer:
+    def test_comments_and_strings(self):
+        stmts = parse("select 'it''s' -- trailing\n /* block */ as x")
+        item = stmts[0].items[0]
+        assert item.expr.value == "it's"
+        assert item.alias == "x"
+
+    def test_position_in_errors(self):
+        with pytest.raises(ParseError, match="line 2"):
+            parse("select\n  @ from t")
+
+
+class TestExpressions:
+    def q(self, expr_sql):
+        return parse_one(f"select {expr_sql} from t").items[0].expr
+
+    def test_precedence_arith_over_comparison(self):
+        e = self.q("a + b * 2 > c - 1")
+        assert isinstance(e, ast.BinaryOp) and e.op == ">"
+        assert e.left.op == "+"
+        assert e.left.right.op == "*"
+
+    def test_and_or_precedence(self):
+        e = self.q("a = 1 or b = 2 and c = 3")
+        assert e.op == "OR"
+        assert e.right.op == "AND"
+
+    def test_not_binds_tighter_than_and(self):
+        e = self.q("not a = 1 and b = 2")
+        assert e.op == "AND"
+        assert isinstance(e.left, ast.UnaryOp) and e.left.op == "NOT"
+
+    def test_between_and_in(self):
+        e = self.q("x between 1 and 10")
+        assert isinstance(e, ast.Between)
+        e = self.q("x not in (1, 2, 3)")
+        assert isinstance(e, ast.InList) and e.negated
+        assert len(e.items) == 3
+
+    def test_like(self):
+        e = self.q("p_type like '%BRASS'")
+        assert isinstance(e, ast.Like)
+        assert e.pattern.value == "%BRASS"
+
+    def test_case_when(self):
+        e = self.q("case when a = 1 then 'one' else 'other' end")
+        assert isinstance(e, ast.CaseWhen)
+        assert len(e.whens) == 1
+        assert e.else_result.value == "other"
+
+    def test_date_and_interval_literals(self):
+        e = self.q("date '1998-12-01' - interval '90' day")
+        assert e.op == "-"
+        assert e.left.type_hint == "date"
+        assert e.right.type_hint == "interval"
+        assert e.right.value == 90 and e.right.interval_unit == "day"
+
+    def test_interval_unit_inside_string(self):
+        e = self.q("d + interval '3 month'")
+        assert e.right.interval_unit == "month"
+
+    def test_qualified_refs_and_star(self):
+        e = self.q("t1.col")
+        assert e == ast.ColumnRef("col", "t1")
+        sel = parse_one("select t.* from t")
+        assert sel.items[0].expr == ast.Star("t")
+
+    def test_agg_calls(self):
+        e = self.q("count(*)")
+        assert e.star
+        e = self.q("count(distinct x)")
+        assert e.distinct
+        e = self.q("sum(a * b)")
+        assert ast.is_aggregate_call(e)
+
+    def test_cast_both_syntaxes(self):
+        assert isinstance(self.q("cast(x as bigint)"), ast.Cast)
+        e = self.q("x::decimal(15,2)")
+        assert isinstance(e, ast.Cast) and e.type_name == "decimal(15,2)"
+
+    def test_extract_and_substring(self):
+        e = self.q("extract(year from o_orderdate)")
+        assert isinstance(e, ast.Extract) and e.part == "year"
+        e = self.q("substring(c_phone from 1 for 2)")
+        assert isinstance(e, ast.Substring)
+
+    def test_scalar_and_in_subquery(self):
+        sel = parse_one(
+            "select * from t where x > (select avg(y) from u) "
+            "and k in (select k from v)")
+        w = sel.where
+        assert isinstance(w.left.right, ast.ScalarSubquery)
+        assert isinstance(w.right, ast.InSubquery)
+
+    def test_exists(self):
+        sel = parse_one("select * from t where exists (select 1 from u)")
+        assert isinstance(sel.where, ast.Exists)
+
+    def test_unary_minus_folds_literal(self):
+        assert self.q("-5") == ast.Literal(-5)
+
+
+class TestSelectShape:
+    def test_joins_explicit(self):
+        sel = parse_one(
+            "select * from a join b on a.k = b.k "
+            "left join c on b.j = c.j")
+        j = sel.from_items[0]
+        assert isinstance(j, ast.Join) and j.join_type == "left"
+        assert j.left.join_type == "inner"
+
+    def test_join_using(self):
+        sel = parse_one("select * from a join b using (k)")
+        j = sel.from_items[0]
+        assert j.condition is None and j.using_cols == ("k",)
+
+    def test_implicit_cross_join_list(self):
+        sel = parse_one("select * from a, b, c where a.x = b.x")
+        assert len(sel.from_items) == 3
+
+    def test_subquery_in_from(self):
+        sel = parse_one("select s.x from (select x from t) s")
+        assert isinstance(sel.from_items[0], ast.SubqueryRef)
+
+    def test_cte(self):
+        sel = parse_one(
+            "with r as (select x from t), s as (select y from u) "
+            "select * from r, s")
+        assert [c.name for c in sel.ctes] == ["r", "s"]
+
+    def test_group_having_order_limit(self):
+        sel = parse_one(
+            "select k, count(*) c from t group by k having count(*) > 5 "
+            "order by c desc nulls last limit 3 offset 1")
+        assert sel.group_by and sel.having is not None
+        assert sel.order_by[0].descending
+        assert sel.order_by[0].nulls_first is False
+        assert sel.limit == 3 and sel.offset == 1
+
+    def test_distinct(self):
+        assert parse_one("select distinct x from t").distinct
+
+
+class TestTPCH:
+    def test_q1_full_shape(self):
+        sel = parse_one(TPCH_Q1)
+        assert len(sel.items) == 10
+        assert sel.items[4].alias == "sum_disc_price"
+        assert len(sel.group_by) == 2
+        assert len(sel.order_by) == 2
+
+    def test_q3_full_shape(self):
+        sel = parse_one(TPCH_Q3)
+        assert len(sel.from_items) == 3
+        assert sel.limit == 10
+        assert sel.order_by[0].descending
+
+    def test_q5_full_shape(self):
+        sel = parse_one(TPCH_Q5)
+        assert len(sel.from_items) == 6
+        # date + interval '1' year arithmetic parsed
+        conds = str(sel.where)
+        assert "INTERVAL '1' YEAR" in conds
+
+
+class TestOtherStatements:
+    def test_create_table(self):
+        st = parse_one(
+            "create table if not exists t (a int not null, b varchar(10), "
+            "c decimal(15,2), d date)")
+        assert isinstance(st, ast.CreateTable) and st.if_not_exists
+        assert st.columns[0].not_null
+        assert st.columns[1].type_name == "varchar(10)"
+
+    def test_drop_table(self):
+        st = parse_one("drop table if exists t")
+        assert isinstance(st, ast.DropTable) and st.if_exists
+
+    def test_insert_values(self):
+        st = parse_one("insert into t (a, b) values (1, 'x'), (2, 'y')")
+        assert isinstance(st, ast.InsertValues)
+        assert len(st.rows) == 2 and st.columns == ("a", "b")
+
+    def test_insert_select(self):
+        st = parse_one("insert into t select * from u where x > 0")
+        assert isinstance(st, ast.InsertSelect)
+
+    def test_copy(self):
+        st = parse_one(
+            "copy lineitem from '/tmp/l.tbl' with (format text, "
+            "delimiter '|', header)")
+        assert isinstance(st, ast.CopyFrom)
+        assert st.format == "text" and st.delimiter == "|" and st.header
+
+    def test_explain_analyze(self):
+        st = parse_one("explain analyze select * from t")
+        assert isinstance(st, ast.Explain) and st.analyze
+        assert isinstance(st.statement, ast.Select)
+
+    def test_set_show(self):
+        st = parse_one("set citus.shard_count = 32")
+        assert st.name == "shard_count" and st.value == 32
+        st = parse_one("show shard_count")
+        assert st.name == "shard_count"
+
+    def test_script_multi_statement(self):
+        stmts = parse("create table t (a int); select * from t;")
+        assert len(stmts) == 2
+
+    def test_error_messages_name_position(self):
+        with pytest.raises(ParseError, match="expected"):
+            parse_one("select from where")
+
+    def test_syntax_errors_never_leak_valueerror(self):
+        # regression: int()/float() on malformed tokens must surface as
+        # ParseError with position, not bare ValueError
+        for bad in ("select x from t limit 1.5",
+                    "select x from t offset 1e3",
+                    "select d + interval '1.5' month from t",
+                    "select d + interval 'abc' day from t"):
+            with pytest.raises(ParseError):
+                parse_one(bad)
+
+    def test_multiline_string_keeps_positions(self):
+        with pytest.raises(ParseError, match="line 3"):
+            parse("select 'a\nb',\n @")
+
+    def test_quoted_ident_doubled_quote_escape(self):
+        sel = parse_one('select "a""b" from t')
+        assert sel.items[0].expr == ast.ColumnRef('a"b')
