@@ -1,0 +1,111 @@
+"""Block: the device-side batch of rows (columns + row validity + nulls).
+
+The tuple-at-a-time TupleTableSlot world of the reference
+(executor/tuple_destination.c) collapses into one pytree of fixed-shape
+arrays: a whole shard (or shuffle partition) processed as vectors.  Filters
+never shrink arrays — they clear `valid` bits — so every shape stays static
+under jit (the XLA contract, SURVEY §7 design stance).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class Block:
+    """columns: name → [N] array; valid: [N] row mask;
+    nulls: name → [N] True-where-NULL (absent key = no nulls)."""
+
+    columns: dict[str, jnp.ndarray]
+    valid: jnp.ndarray
+    nulls: dict[str, jnp.ndarray] = field(default_factory=dict)
+
+    @property
+    def capacity(self) -> int:
+        return int(self.valid.shape[0])
+
+    def column(self, name: str) -> jnp.ndarray:
+        return self.columns[name]
+
+    def null_mask(self, name: str) -> jnp.ndarray:
+        """[N] bool, True where value is NULL."""
+        if name in self.nulls:
+            return self.nulls[name]
+        return jnp.zeros(self.valid.shape, dtype=jnp.bool_)
+
+    def not_null(self, name: str) -> jnp.ndarray:
+        return ~self.null_mask(name)
+
+    def with_filter(self, mask: jnp.ndarray) -> "Block":
+        return Block(self.columns, self.valid & mask, self.nulls)
+
+    def select(self, names: list[str]) -> "Block":
+        return Block({n: self.columns[n] for n in names}, self.valid,
+                     {n: m for n, m in self.nulls.items() if n in names})
+
+    def with_column(self, name: str, values: jnp.ndarray,
+                    null_mask: jnp.ndarray | None = None) -> "Block":
+        cols = dict(self.columns)
+        cols[name] = values
+        nulls = dict(self.nulls)
+        if null_mask is not None:
+            nulls[name] = null_mask
+        else:
+            nulls.pop(name, None)
+        return Block(cols, self.valid, nulls)
+
+    def row_count(self) -> jnp.ndarray:
+        return self.valid.sum()
+
+
+def block_from_numpy(values: dict[str, np.ndarray],
+                     validity: dict[str, np.ndarray] | None = None,
+                     capacity: int | None = None,
+                     compute_dtype=None) -> Block:
+    """Host arrays → padded device Block.
+
+    Per-column validity from storage becomes `nulls`; rows beyond the real
+    row count are padding (valid=False).  float64 storage downcasts to
+    `compute_dtype` when given (the TPU f32 policy).
+    """
+    n = len(next(iter(values.values())))
+    cap = capacity or n
+    if cap < n:
+        raise ValueError(f"capacity {cap} < rows {n}")
+    cols = {}
+    nulls = {}
+    for name, arr in values.items():
+        if compute_dtype is not None and arr.dtype == np.float64:
+            arr = arr.astype(compute_dtype)
+        pad = np.zeros(cap - n, dtype=arr.dtype)
+        cols[name] = jnp.asarray(np.concatenate([arr, pad]))
+        if validity and name in validity:
+            v = np.asarray(validity[name], dtype=bool)
+            if not v.all():
+                nulls[name] = jnp.asarray(np.concatenate(
+                    [~v, np.zeros(cap - n, dtype=bool)]))
+    valid = jnp.asarray(np.concatenate(
+        [np.ones(n, dtype=bool), np.zeros(cap - n, dtype=bool)]))
+    return Block(cols, valid, nulls)
+
+
+def block_to_numpy(block: Block) -> tuple[dict[str, np.ndarray], np.ndarray, dict[str, np.ndarray]]:
+    """Device Block → host (columns, valid, nulls) as numpy."""
+    cols = {n: np.asarray(a) for n, a in block.columns.items()}
+    valid = np.asarray(block.valid)
+    nulls = {n: np.asarray(a) for n, a in block.nulls.items()}
+    return cols, valid, nulls
+
+
+def compact_to_numpy(block: Block) -> tuple[dict[str, np.ndarray], dict[str, np.ndarray]]:
+    """Gather only valid rows host-side (final result materialization)."""
+    cols, valid, nulls = block_to_numpy(block)
+    out = {n: a[valid] for n, a in cols.items()}
+    out_nulls = {n: a[valid] for n, a in nulls.items()}
+    return out, out_nulls

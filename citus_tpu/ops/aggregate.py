@@ -1,0 +1,127 @@
+"""Sort-based grouped aggregation with static output capacity.
+
+The TPU-native replacement for the reference's two-level aggregation
+(worker partial aggregate + coordinator combine,
+/root/reference/src/backend/distributed/planner/multi_logical_optimizer.c:1419
+MasterExtendedOpNode / WorkerExtendedOpNode): instead of a dynamic hash
+table, rows are sorted by group key (XLA-friendly, deterministic) and
+reduced with segment operations.  Output capacity == input capacity, so
+there is NO overflow case: in the worst degenerate case every row is its own
+group.  `group_valid` marks which output slots hold real groups.
+
+This same primitive serves: GROUP BY (partial + final), DISTINCT, and the
+merge step after an all_to_all repartition.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+SUPPORTED_AGGS = ("sum", "count", "min", "max")
+
+
+def _sort_order(keys: list[jnp.ndarray], valid: jnp.ndarray) -> jnp.ndarray:
+    """Stable order: valid rows first, grouped by key columns."""
+    invalid = (~valid).astype(jnp.int32)
+    # lexsort: LAST key is primary
+    return jnp.lexsort(tuple(reversed(keys)) + (invalid,))
+
+
+@dataclass(frozen=True)
+class AggSpec:
+    """One aggregate over one input array."""
+
+    kind: str            # sum | count | min | max
+    # count counts rows where contributing value is non-null (input_valid)
+
+
+def segment_aggregate(keys: list[jnp.ndarray],
+                      values: list[tuple[jnp.ndarray, str, jnp.ndarray | None]],
+                      valid: jnp.ndarray,
+                      ) -> tuple[list[jnp.ndarray], list[jnp.ndarray], jnp.ndarray, jnp.ndarray]:
+    """Group rows by `keys` and reduce.
+
+    Args:
+      keys:   key columns, each [N].
+      values: (array [N], kind, value_valid [N] | None) per aggregate;
+              value_valid masks per-column NULLs (count(col), sum skips null).
+      valid:  row validity [N].
+
+    Returns (group_keys, agg_results, group_valid, n_groups):
+      group_keys:  each [N], key value of each group slot,
+      agg_results: each [N],
+      group_valid: [N] bool, slots < n_groups,
+      n_groups:    scalar int32.
+    """
+    n = valid.shape[0]
+    order = _sort_order(keys, valid)
+    keys_s = [k[order] for k in keys]
+    valid_s = valid[order]
+
+    # boundary: first row of each (valid) group
+    def _shift_ne(a):
+        return jnp.concatenate([jnp.ones((1,), jnp.bool_),
+                                a[1:] != a[:-1]])
+
+    diff = jnp.zeros(n, dtype=jnp.bool_)
+    for k in keys_s:
+        diff = diff | _shift_ne(k)
+    boundary = diff & valid_s
+    seg_id = jnp.cumsum(boundary.astype(jnp.int32)) - 1
+    n_groups = boundary.sum().astype(jnp.int32)
+    # invalid rows (sorted last) land in the last group's segment with
+    # identity contributions; the clip only guards the all-invalid case
+    # (seg_id would be -1 everywhere)
+    seg_id = jnp.clip(seg_id, 0, None)
+
+    group_keys = []
+    first_idx = jax.ops.segment_min(jnp.arange(n), seg_id, num_segments=n)
+    first_idx = jnp.clip(first_idx, 0, n - 1)
+    for k in keys_s:
+        group_keys.append(k[first_idx])
+
+    results = []
+    for arr, kind, value_valid in values:
+        arr_s = arr[order]
+        contrib_valid = valid_s if value_valid is None else (
+            valid_s & value_valid[order])
+        if kind == "count":
+            res = jax.ops.segment_sum(contrib_valid.astype(jnp.int64),
+                                      seg_id, num_segments=n)
+        elif kind == "sum":
+            z = jnp.zeros((), dtype=arr_s.dtype)
+            res = jax.ops.segment_sum(jnp.where(contrib_valid, arr_s, z),
+                                      seg_id, num_segments=n)
+        elif kind == "min":
+            big = _identity_for(arr_s.dtype, "min")
+            res = jax.ops.segment_min(jnp.where(contrib_valid, arr_s, big),
+                                      seg_id, num_segments=n)
+        elif kind == "max":
+            small = _identity_for(arr_s.dtype, "max")
+            res = jax.ops.segment_max(jnp.where(contrib_valid, arr_s, small),
+                                      seg_id, num_segments=n)
+        else:
+            raise ValueError(f"unsupported aggregate kind {kind!r}")
+        results.append(res)
+
+    group_valid = jnp.arange(n) < n_groups
+    group_keys = [jnp.where(group_valid, k,
+                            jnp.zeros((), dtype=k.dtype)) for k in group_keys]
+    return group_keys, results, group_valid, n_groups
+
+
+def _identity_for(dtype, kind: str):
+    if jnp.issubdtype(dtype, jnp.floating):
+        inf = jnp.asarray(jnp.inf, dtype=dtype)
+        return inf if kind == "min" else -inf
+    info = jnp.iinfo(dtype)
+    return jnp.asarray(info.max if kind == "min" else info.min, dtype=dtype)
+
+
+def distinct(keys: list[jnp.ndarray], valid: jnp.ndarray):
+    """DISTINCT = grouping with no aggregates."""
+    gk, _, gv, n = segment_aggregate(keys, [], valid)
+    return gk, gv, n

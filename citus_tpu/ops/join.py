@@ -1,0 +1,168 @@
+"""Equi-join kernels: exact lexicographic binary-search lookup join.
+
+TPU-native replacement for the reference's hash build/probe executed per
+shard on workers (co-located pushdown joins,
+/root/reference/src/backend/distributed/planner/query_pushdown_planning.c;
+repartition merge tasks, multi_physical_planner.c BuildMapMergeJob): instead
+of pointer-chasing hash tables, the build side is sorted once and probes run
+a vectorized lexicographic binary search (log2(M) gather steps — all MXU/VPU
+friendly dense ops, no data-dependent shapes).
+
+Multi-column keys are compared exactly (no hash-combine collisions): the
+search carries the full key tuple through the comparison at every step.
+
+Unique-build lookup (PK-FK, the TPC-H shape) returns one match per probe
+row.  `expand_join` handles the general many-to-many case with a static
+output capacity + overflow flag the host retries on
+(SURVEY §7 hard part #1: capacity padding + count-then-emit).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+def _lex_less(a: list[jnp.ndarray], b: list[jnp.ndarray]) -> jnp.ndarray:
+    """a < b lexicographically; arrays broadcast elementwise."""
+    out = jnp.zeros(jnp.broadcast_shapes(a[0].shape, b[0].shape), jnp.bool_)
+    tie = jnp.ones_like(out)
+    for x, y in zip(a, b):
+        out = out | (tie & (x < y))
+        tie = tie & (x == y)
+    return out
+
+
+def _lex_eq(a: list[jnp.ndarray], b: list[jnp.ndarray]) -> jnp.ndarray:
+    out = jnp.ones(jnp.broadcast_shapes(a[0].shape, b[0].shape), jnp.bool_)
+    for x, y in zip(a, b):
+        out = out & (x == y)
+    return out
+
+
+def sort_build_side(build_keys: list[jnp.ndarray], build_valid: jnp.ndarray,
+                    ) -> tuple[list[jnp.ndarray], jnp.ndarray, jnp.ndarray]:
+    """Sort build rows by key, invalid rows last.
+
+    Returns (sorted_keys, order, n_valid).  Invalid rows keep their key
+    values but sort after all valid rows, and lookups clamp to n_valid.
+    """
+    invalid = (~build_valid).astype(jnp.int32)
+    order = jnp.lexsort(tuple(reversed(build_keys)) + (invalid,))
+    sorted_keys = [k[order] for k in build_keys]
+    n_valid = build_valid.sum().astype(jnp.int32)
+    return sorted_keys, order, n_valid
+
+
+def lower_bound(sorted_keys: list[jnp.ndarray], n_valid: jnp.ndarray,
+                probe_keys: list[jnp.ndarray]) -> jnp.ndarray:
+    """Vectorized lexicographic lower_bound over the sorted build side.
+
+    Returns, per probe row, the first index in [0, n_valid] whose key is
+    >= the probe key.  ceil(log2(M))+1 fixed iterations (static shape).
+    """
+    m = sorted_keys[0].shape[0]
+    n = probe_keys[0].shape[0]
+    steps = max(1, math.ceil(math.log2(m + 1)))
+    lo = jnp.zeros(n, dtype=jnp.int32)
+    hi = jnp.broadcast_to(n_valid.astype(jnp.int32), (n,))
+
+    def body(_, carry):
+        lo, hi = carry
+        active = lo < hi  # converged lanes must stay put (fixed trip count)
+        mid = (lo + hi) // 2
+        mid_c = jnp.clip(mid, 0, m - 1)
+        mid_keys = [k[mid_c] for k in sorted_keys]
+        less = _lex_less(mid_keys, probe_keys)
+        lo = jnp.where(active & less, mid + 1, lo)
+        hi = jnp.where(active & ~less, mid, hi)
+        return lo, hi
+
+    lo, hi = jax.lax.fori_loop(0, steps, body, (lo, hi))
+    return lo
+
+
+def lookup_join(build_keys: list[jnp.ndarray], build_valid: jnp.ndarray,
+                probe_keys: list[jnp.ndarray], probe_valid: jnp.ndarray,
+                ) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """One-match-per-probe equi-join (build side unique on key — PK side).
+
+    Returns (build_row_idx [N] into the ORIGINAL build arrays, found [N]).
+    If the build side has duplicate keys, the first (in sorted order) wins —
+    callers that need all matches use expand_join.
+    """
+    sorted_keys, order, n_valid = sort_build_side(build_keys, build_valid)
+    pos = lower_bound(sorted_keys, n_valid, probe_keys)
+    m = sorted_keys[0].shape[0]
+    pos_c = jnp.clip(pos, 0, m - 1)
+    hit_keys = [k[pos_c] for k in sorted_keys]
+    found = (probe_valid & (pos < n_valid) & _lex_eq(hit_keys, probe_keys))
+    build_idx = order[pos_c]
+    return build_idx, found
+
+
+def match_counts(build_keys: list[jnp.ndarray], build_valid: jnp.ndarray,
+                 probe_keys: list[jnp.ndarray], probe_valid: jnp.ndarray,
+                 ) -> jnp.ndarray:
+    """Number of build matches per probe row (count phase of count-then-emit)."""
+    sorted_keys, _, n_valid = sort_build_side(build_keys, build_valid)
+    lo = lower_bound(sorted_keys, n_valid, probe_keys)
+    hi = _upper_bound(sorted_keys, n_valid, probe_keys)
+    return jnp.where(probe_valid, jnp.maximum(hi - lo, 0), 0)
+
+
+def _upper_bound(sorted_keys, n_valid, probe_keys):
+    """First index with key > probe: lower_bound of (probe, last_col+1).
+
+    Integer keys only: for floats, +1 is not "next representable value"
+    (3e8f + 1 == 3e8f) and ranges would be wrong.  The planner only emits
+    integer join keys (ints, dates, dictionary codes).
+
+    The +1 wraps at the dtype max; those lanes fall back to n_valid (every
+    remaining key compares equal-or-less), which the max(hi-lo, 0) clamp in
+    callers keeps sound."""
+    last = probe_keys[-1]
+    if not jnp.issubdtype(last.dtype, jnp.integer):
+        raise TypeError(
+            f"multi-match join keys must be integers, got {last.dtype}; "
+            "cast float keys at plan time")
+    bumped_last = last + 1
+    wrapped = bumped_last < last
+    hi = lower_bound(sorted_keys, n_valid, probe_keys[:-1] + [bumped_last])
+    return jnp.where(wrapped, jnp.broadcast_to(n_valid, hi.shape), hi)
+
+
+def expand_join(build_keys: list[jnp.ndarray], build_valid: jnp.ndarray,
+                probe_keys: list[jnp.ndarray], probe_valid: jnp.ndarray,
+                capacity: int,
+                ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """General many-to-many equi-join with static output capacity.
+
+    Emits (build_idx [C], probe_idx [C], out_valid [C], overflow_count):
+    every (build, probe) key-match pair, padded to `capacity`.  If total
+    matches exceed capacity, overflow_count > 0 and the host retries with a
+    larger capacity (CapacityOverflowError protocol).
+    """
+    sorted_keys, order, n_valid = sort_build_side(build_keys, build_valid)
+    lo = lower_bound(sorted_keys, n_valid, probe_keys)
+    hi = _upper_bound(sorted_keys, n_valid, probe_keys)
+    counts = jnp.where(probe_valid, jnp.maximum(hi - lo, 0), 0)
+    total = counts.sum()
+    starts = jnp.cumsum(counts) - counts  # exclusive prefix
+
+    # emit: out slot j in [starts[i], starts[i]+counts[i]) maps to probe i,
+    # build sorted index lo[i] + (j - starts[i]).
+    # Recover i per output slot via searchsorted over starts.
+    slots = jnp.arange(capacity, dtype=counts.dtype)
+    probe_idx = jnp.searchsorted(starts, slots, side="right") - 1
+    n = probe_keys[0].shape[0]
+    probe_idx = jnp.clip(probe_idx, 0, n - 1)
+    offset = slots - starts[probe_idx]
+    out_valid = (slots < total) & (offset < counts[probe_idx])
+    m = sorted_keys[0].shape[0]
+    sorted_pos = jnp.clip(lo[probe_idx] + offset, 0, m - 1)
+    build_idx = order[sorted_pos]
+    overflow = jnp.maximum(total - capacity, 0)
+    return build_idx, probe_idx, out_valid, overflow

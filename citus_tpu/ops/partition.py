@@ -1,0 +1,64 @@
+"""Radix partition packing: rows → fixed [n_targets, capacity] buffers.
+
+The map phase of the shuffle (reference: worker_partition_query_result
+hashing rows into N partition files, /root/reference/src/backend/distributed/
+executor/partitioned_intermediate_results.c:108) — rebuilt as a dense pack
+whose output feeds `jax.lax.all_to_all` over ICI directly, replacing the
+fetch_intermediate_results COPY-over-TCP hop entirely (SURVEY §3.2).
+
+Static capacity per target partition; the overflow count is returned so the
+host can re-run with a larger capacity (count-then-emit at host granularity).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def partition_ranks(target: jnp.ndarray, valid: jnp.ndarray, n_targets: int,
+                    ) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-row rank within its target partition + per-target counts.
+
+    target: [N] int32 in [0, n_targets); rank via stable sort by target.
+    Returns (rank [N] — only meaningful for valid rows, counts [n_targets]).
+    """
+    n = target.shape[0]
+    t = jnp.where(valid, target, n_targets).astype(jnp.int32)
+    order = jnp.argsort(t, stable=True)
+    t_sorted = t[order]
+    # first occurrence index of each target value among sorted rows
+    first = jax.ops.segment_min(jnp.arange(n, dtype=jnp.int32), t_sorted,
+                                num_segments=n_targets + 1)
+    rank_sorted = jnp.arange(n, dtype=jnp.int32) - first[t_sorted]
+    # scatter ranks back to original row order
+    rank = jnp.zeros(n, dtype=jnp.int32).at[order].set(rank_sorted)
+    counts = jax.ops.segment_sum(valid.astype(jnp.int32), t,
+                                 num_segments=n_targets + 1)[:n_targets]
+    return rank, counts
+
+
+def pack_by_target(columns: dict[str, jnp.ndarray], valid: jnp.ndarray,
+                   target: jnp.ndarray, n_targets: int, capacity: int,
+                   ) -> tuple[dict[str, jnp.ndarray], jnp.ndarray, jnp.ndarray]:
+    """Scatter rows into [n_targets, capacity] per column.
+
+    Returns (packed_columns, packed_valid [n_targets, capacity],
+    overflow_count — rows dropped because their partition exceeded capacity).
+    Overflow > 0 ⇒ results incomplete ⇒ host retries with larger capacity.
+    """
+    rank, counts = partition_ranks(target, valid, n_targets)
+    in_cap = rank < capacity
+    ok = valid & in_cap
+    flat_idx = jnp.where(ok, target * capacity + rank,
+                         n_targets * capacity)  # OOB → dropped
+    packed_valid = jnp.zeros(n_targets * capacity, dtype=jnp.bool_
+                             ).at[flat_idx].set(ok, mode="drop")
+    packed = {}
+    for name, col in columns.items():
+        buf = jnp.zeros(n_targets * capacity, dtype=col.dtype)
+        buf = buf.at[flat_idx].set(jnp.where(ok, col, jnp.zeros((), col.dtype)),
+                                   mode="drop")
+        packed[name] = buf.reshape(n_targets, capacity)
+    overflow = jnp.maximum(counts - capacity, 0).sum()
+    return packed, packed_valid.reshape(n_targets, capacity), overflow

@@ -1,0 +1,46 @@
+"""JAX runtime configuration guard.
+
+The framework's routing contract (host/device hash parity, int64 shard keys)
+requires 64-bit types on device.  JAX defaults to x64-off and silently
+downcasts int64 → int32 at jnp.asarray, which would silently break shuffle
+routing (rows land on wrong shards, joins lose rows).  Every entry point —
+Session, executors, bench — calls ensure_jax_configured() before touching
+device arrays.
+"""
+
+from __future__ import annotations
+
+import os
+
+_configured = False
+
+
+def ensure_jax_configured(platform: str | None = None,
+                          host_device_count: int | None = None) -> None:
+    """Idempotently enable x64 (and optionally pick a platform / virtual
+    device count).  Must run before the first JAX backend use; platform and
+    device-count changes after backend init raise RuntimeError."""
+    global _configured
+    if host_device_count is not None and not _configured:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={host_device_count}")
+
+    import jax
+
+    # NB: env vars (JAX_PLATFORMS / JAX_ENABLE_X64) are not reliably honored
+    # in every deployment (TPU plugins can win); the config API is.
+    jax.config.update("jax_enable_x64", True)
+    if platform is not None:
+        jax.config.update("jax_platforms", platform)
+    _configured = True
+
+
+def require_x64() -> None:
+    import jax
+
+    if not jax.config.jax_enable_x64:
+        raise RuntimeError(
+            "citus_tpu requires jax_enable_x64 (int64 shard keys); call "
+            "citus_tpu.runtime.ensure_jax_configured() before device work")

@@ -11,8 +11,8 @@ keys (the reference relies on PG plan-cache invariants for the same purpose).
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
-from typing import Optional, Union
+from dataclasses import dataclass
+from typing import Optional
 
 
 class Node:

@@ -145,6 +145,8 @@ def tokenize(sql: str) -> list[Token]:
                         break
                 else:
                     break
+            if j < n and (sql[j].isalpha() or sql[j] == "_"):
+                err(f"trailing junk after numeric literal: {sql[i:j+1]!r}")
             tokens.append(Token("number", sql[i:j], start_line, start_col))
             col += j - i
             i = j
