@@ -1,0 +1,40 @@
+"""Device mesh management.
+
+The reference's "cluster" is worker nodes wired by libpq
+(connection/connection_management.c); here it is a jax.sharding.Mesh with a
+single 'shards' axis.  Multi-host TPU pods extend the same mesh over
+ICI/DCN transparently (jax.distributed) — the executor code is identical.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+SHARD_AXIS = "shards"
+
+
+def make_mesh(n_devices: int | None = None) -> Mesh:
+    devs = jax.devices()
+    n = n_devices or len(devs)
+    if n > len(devs):
+        raise ValueError(f"requested {n} devices, only {len(devs)} available")
+    return jax.make_mesh((n,), (SHARD_AXIS,), devices=np.array(devs[:n]))
+
+
+def sharded_spec() -> P:
+    return P(SHARD_AXIS)
+
+
+def replicated_spec() -> P:
+    return P()
+
+
+def put_sharded(mesh: Mesh, arr: np.ndarray) -> jax.Array:
+    """[n_dev, ...] host array → device array split on axis 0."""
+    return jax.device_put(arr, NamedSharding(mesh, P(SHARD_AXIS)))
+
+
+def put_replicated(mesh: Mesh, arr: np.ndarray) -> jax.Array:
+    return jax.device_put(arr, NamedSharding(mesh, P()))

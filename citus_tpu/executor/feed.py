@@ -1,0 +1,136 @@
+"""Host→device data feed: shard stripes → padded mesh-sharded arrays.
+
+Replaces the reference's per-tuple worker scan + COPY result streaming with
+bulk columnar placement: each device's rows are the concatenation of its
+shards' stripes (colocation-preserving), padded to a common static
+capacity, laid out as [n_devices, capacity] and device_put with a
+NamedSharding over the 'shards' mesh axis.  Reference tables feed as
+replicated [capacity] arrays.
+
+Shard pruning (ScanNode.pruned_shards) skips entire shards at feed time —
+the PruneShards analogue executed host-side.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+from jax.sharding import Mesh
+
+from ..catalog import Catalog, DistributionMethod
+from ..errors import ExecutionError
+from ..planner.plan import (
+    AggregateNode,
+    JoinNode,
+    PlanNode,
+    ProjectNode,
+    QueryPlan,
+    ScanNode,
+)
+from ..storage import TableStore
+from ..distributed.mesh import put_replicated, put_sharded
+from .compiler import FeedSpec, _round_cap
+
+
+def walk_plan(node: PlanNode):
+    yield node
+    if isinstance(node, JoinNode):
+        yield from walk_plan(node.left)
+        yield from walk_plan(node.right)
+    elif isinstance(node, (AggregateNode, ProjectNode)):
+        yield from walk_plan(node.input)
+
+
+def build_feeds(plan: QueryPlan, catalog: Catalog, store: TableStore,
+                mesh: Mesh, compute_dtype=np.float32) -> dict[int, FeedSpec]:
+    feeds: dict[int, FeedSpec] = {}
+    for node in walk_plan(plan.root):
+        if isinstance(node, ScanNode):
+            feeds[id(node)] = _feed_scan(node, catalog, store, mesh,
+                                         plan.n_devices, compute_dtype)
+    return feeds
+
+
+def _feed_scan(node: ScanNode, catalog: Catalog, store: TableStore,
+               mesh: Mesh, n_dev: int, compute_dtype) -> FeedSpec:
+    rel = node.rel
+    meta = catalog.table(rel.table)
+    colnames = [cid.split(".", 1)[1] for cid in node.columns]
+    shards = catalog.table_shards(rel.table)
+
+    if meta.method == DistributionMethod.HASH:
+        per_dev_vals: list[dict[str, list[np.ndarray]]] = [
+            {c: [] for c in colnames} for _ in range(n_dev)]
+        per_dev_mask: list[dict[str, list[np.ndarray]]] = [
+            {c: [] for c in colnames} for _ in range(n_dev)]
+        per_dev_rows = [0] * n_dev
+        for s in shards:
+            if node.pruned_shards is not None and \
+                    s.shard_index not in node.pruned_shards:
+                continue
+            dev = (catalog.active_placement(s.shard_id).node_id - 1) % n_dev
+            vals, mask, n = store.read_shard(rel.table, s.shard_id, colnames)
+            if n == 0:
+                continue
+            per_dev_rows[dev] += n
+            for c in colnames:
+                per_dev_vals[dev][c].append(vals[c])
+                per_dev_mask[dev][c].append(mask[c])
+        cap = _round_cap(max(per_dev_rows) if any(per_dev_rows) else 1)
+        arrays, nulls = {}, {}
+        for cid, cname in zip(node.columns, colnames):
+            dtype = rel.schema.column(cname).dtype.numpy_dtype
+            if dtype == np.float64 and compute_dtype is not None:
+                dtype = np.dtype(compute_dtype)
+            buf = np.zeros((n_dev, cap), dtype=dtype)
+            nbuf = np.zeros((n_dev, cap), dtype=bool)
+            has_nulls = False
+            for d in range(n_dev):
+                if per_dev_vals[d][cname]:
+                    v = np.concatenate(per_dev_vals[d][cname]).astype(dtype)
+                    m = np.concatenate(per_dev_mask[d][cname])
+                    buf[d, :len(v)] = v
+                    if not m.all():
+                        has_nulls = True
+                        nbuf[d, :len(m)] = ~m
+            arrays[cid] = buf
+            if has_nulls:
+                nulls[cid] = nbuf
+        valid = np.zeros((n_dev, cap), dtype=bool)
+        for d in range(n_dev):
+            valid[d, :per_dev_rows[d]] = True
+        feed = FeedSpec(node=node, sharded=True, arrays=arrays, nulls=nulls,
+                        valid=valid, capacity=cap)
+    else:
+        # reference/local: single shard replicated to every device
+        if len(shards) != 1:
+            raise ExecutionError(
+                f"table {rel.table}: expected single shard")
+        vals, mask, n = store.read_shard(rel.table, shards[0].shard_id,
+                                         colnames)
+        cap = _round_cap(max(n, 1))
+        arrays, nulls = {}, {}
+        for cid, cname in zip(node.columns, colnames):
+            dtype = rel.schema.column(cname).dtype.numpy_dtype
+            if dtype == np.float64 and compute_dtype is not None:
+                dtype = np.dtype(compute_dtype)
+            buf = np.zeros(cap, dtype=dtype)
+            if n:
+                buf[:n] = vals[cname].astype(dtype)
+                if not mask[cname].all():
+                    nbuf = np.zeros(cap, dtype=bool)
+                    nbuf[:n] = ~mask[cname]
+                    nulls[cid] = nbuf
+            arrays[cid] = buf
+        valid = np.zeros(cap, dtype=bool)
+        valid[:n] = True
+        feed = FeedSpec(node=node, sharded=False, arrays=arrays, nulls=nulls,
+                        valid=valid, capacity=cap)
+
+    # place on the mesh
+    put = put_sharded if feed.sharded else put_replicated
+    feed.arrays = {c: put(mesh, a) for c, a in feed.arrays.items()}
+    feed.nulls = {c: put(mesh, a) for c, a in feed.nulls.items()}
+    feed.valid = put(mesh, feed.valid)
+    return feed

@@ -1,0 +1,241 @@
+"""Distributed execution driver: capacities, retry loop, host combine.
+
+The coordinator-side finish: gathers device outputs, evaluates the combine
+phase (host_select / HAVING / ORDER BY / LIMIT — the combine_query of
+planner/combine_query_planner.c), decodes dictionary strings, and returns a
+ResultSet.  Overflowed static buffers trigger recompile-with-doubled-caps
+(bounded retries), the executor's answer to data-dependent cardinalities.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import numpy as np
+from jax.sharding import Mesh
+
+from ..catalog import Catalog
+from ..config import Settings
+from ..errors import CapacityOverflowError, ExecutionError
+from ..planner import expr as ir
+from ..planner.plan import (
+    AggregateNode,
+    JoinNode,
+    ProjectNode,
+    QueryPlan,
+    ScanNode,
+)
+from ..storage import TableStore
+from ..types import DataType, days_to_date
+from .compiler import Capacities, PlanCompiler, _round_cap
+from .exprs import ColumnSource, evaluate, predicate_mask
+from .feed import build_feeds, walk_plan
+
+MAX_RETRIES = 4
+
+
+@dataclass
+class ResultSet:
+    column_names: list[str]
+    columns: dict[str, np.ndarray | list]
+    row_count: int
+    # execution metadata (EXPLAIN ANALYZE / stats counters read these)
+    retries: int = 0
+    device_rows_scanned: int = 0
+
+    def rows(self) -> list[tuple]:
+        cols = [self.columns[n] for n in self.column_names]
+        return [tuple(c[i] for c in cols) for i in range(self.row_count)]
+
+    def __len__(self):
+        return self.row_count
+
+
+class Executor:
+    def __init__(self, catalog: Catalog, store: TableStore,
+                 settings: Settings, mesh: Mesh):
+        self.catalog = catalog
+        self.store = store
+        self.settings = settings
+        self.mesh = mesh
+
+    # ------------------------------------------------------------------
+    def execute_plan(self, plan: QueryPlan) -> ResultSet:
+        compute_dtype = np.dtype(self.settings.get("compute_dtype"))
+        feeds = build_feeds(plan, self.catalog, self.store, self.mesh,
+                            compute_dtype)
+        caps = self._initial_capacities(plan, feeds)
+        retries = 0
+        while True:
+            compiler = PlanCompiler(plan, self.mesh, feeds, caps,
+                                    compute_dtype)
+            fn, feed_arrays = compiler.build()
+            cols, nulls, valid, overflow = fn(*feed_arrays)
+            total_overflow = int(np.asarray(overflow).sum())
+            if total_overflow == 0:
+                break
+            retries += 1
+            if retries >= MAX_RETRIES:
+                raise CapacityOverflowError(
+                    f"buffer overflow persisted after {retries} retries "
+                    f"({total_overflow} rows dropped)", total_overflow, 0)
+            caps = caps.doubled()
+        result = self._host_combine(plan, cols, nulls, valid)
+        result.retries = retries
+        return result
+
+    # ------------------------------------------------------------------
+    def _initial_capacities(self, plan: QueryPlan, feeds) -> Capacities:
+        """Propagate static per-device capacities bottom-up."""
+        repart_factor = self.settings.get("repartition_capacity_factor")
+        join_factor = self.settings.get("join_output_capacity_factor")
+        n_dev = plan.n_devices
+        repart: dict[int, int] = {}
+        join_out: dict[int, int] = {}
+
+        def cap_of(node) -> int:
+            if isinstance(node, ScanNode):
+                return feeds[id(node)].capacity
+            if isinstance(node, ProjectNode):
+                return cap_of(node.input)
+            if isinstance(node, JoinNode):
+                lcap = cap_of(node.left)
+                rcap = cap_of(node.right)
+                if node.strategy == "repart_right":
+                    repart[id(node)] = _round_cap(int(rcap * repart_factor))
+                    rcap = n_dev * repart[id(node)]
+                elif node.strategy == "repart_left":
+                    repart[id(node)] = _round_cap(int(lcap * repart_factor))
+                    lcap = n_dev * repart[id(node)]
+                elif node.strategy == "repart_both":
+                    repart[id(node)] = _round_cap(
+                        int(max(lcap, rcap) * repart_factor))
+                    lcap = n_dev * repart[id(node)]
+                if not node.left_keys:
+                    # cartesian: output is the full product
+                    out = _round_cap(lcap * rcap)
+                else:
+                    # probe side is the left/outer side
+                    out = _round_cap(int(lcap * join_factor) + 128)
+                join_out[id(node)] = out
+                return out
+            if isinstance(node, AggregateNode):
+                in_cap = cap_of(node.input)
+                if node.combine == "global":
+                    return 1
+                if node.combine == "repartition":
+                    repart[id(node)] = _round_cap(int(in_cap * repart_factor))
+                    return n_dev * repart[id(node)]
+                return in_cap
+            raise ExecutionError(f"unknown node {type(node).__name__}")
+
+        cap_of(plan.root)
+        return Capacities(repart, join_out)
+
+    # ------------------------------------------------------------------
+    def _host_combine(self, plan: QueryPlan, cols, nulls, valid) -> ResultSet:
+        valid_np = np.asarray(valid).reshape(-1)
+        flat_cols: dict[str, np.ndarray] = {}
+        flat_nulls: dict[str, np.ndarray] = {}
+        for cid in cols:
+            arr = np.asarray(cols[cid]).reshape(-1)
+            flat_cols[cid] = arr[valid_np]
+            nmask = np.asarray(nulls[cid]).reshape(-1)
+            flat_nulls[cid] = nmask[valid_np]
+        src = ColumnSource(flat_cols, flat_nulls)
+        n = int(valid_np.sum())
+
+        # HAVING
+        if plan.host_having is not None:
+            mask = np.broadcast_to(np.asarray(
+                predicate_mask(plan.host_having, src, np)), (n,))
+            flat_cols = {c: a[mask] for c, a in flat_cols.items()}
+            flat_nulls = {c: a[mask] for c, a in flat_nulls.items()}
+            src = ColumnSource(flat_cols, flat_nulls)
+            n = int(mask.sum())
+
+        # select outputs
+        out_cols: dict[str, object] = {}
+        out_nulls: dict[str, np.ndarray] = {}
+        names: list[str] = []
+        for e, name in plan.host_select:
+            v, nmask = evaluate(e, src, np)
+            v = np.broadcast_to(np.asarray(v), (n,)).copy()
+            nmask = (np.zeros(n, dtype=bool) if nmask is None
+                     else np.broadcast_to(np.asarray(nmask), (n,)).copy())
+            out_name = self._unique_name(name, names)
+            names.append(out_name)
+            out_cols[out_name] = v
+            out_nulls[out_name] = nmask
+            # decode dictionary strings / format dates
+            if isinstance(e, ir.BCol) and e.cid in plan.decode:
+                table, column = plan.decode[e.cid]
+                d = self.store.dictionary(table, column)
+                out_cols[out_name] = np.array(
+                    [None if nm else d.value_of(int(c))
+                     for c, nm in zip(v, nmask)], dtype=object)
+            elif e.dtype == DataType.DATE:
+                out_cols[out_name] = np.array(
+                    [None if nm else days_to_date(int(c))
+                     for c, nm in zip(v, nmask)], dtype=object)
+
+        # ORDER BY (host): exact multi-key sort via factorize + lexsort.
+        # Values factorize through np.unique (ascending codes — exact for
+        # any dtype incl. decoded strings); DESC negates codes; NULL
+        # placement follows PG defaults (NULLS LAST for ASC, FIRST for DESC)
+        if plan.host_order_by and n > 0:
+            order_src = ColumnSource(flat_cols, flat_nulls)
+            lex_keys = []  # built primary-first, reversed for np.lexsort
+            for e, desc, nulls_first in plan.host_order_by:
+                v, nmask = evaluate(e, order_src, np)
+                v = np.broadcast_to(np.asarray(v), (n,))
+                nmask = (np.zeros(n, dtype=bool) if nmask is None
+                         else np.broadcast_to(np.asarray(nmask), (n,)))
+                if isinstance(e, ir.BCol) and e.cid in plan.decode:
+                    table, column = plan.decode[e.cid]
+                    d = self.store.dictionary(table, column)
+                    v = np.array([d.value_of(int(c)) if 0 <= c < len(d)
+                                  else "" for c in v])
+                _, codes = np.unique(v, return_inverse=True)
+                codes = codes.astype(np.int64)
+                if desc:
+                    codes = -codes
+                nulls_last = (not nulls_first if nulls_first is not None
+                              else not desc)
+                null_key = nmask if nulls_last else ~nmask
+                # per item: null placement outranks the value code
+                lex_keys.append(null_key.astype(np.int8))
+                lex_keys.append(codes)
+            order = np.lexsort(tuple(reversed(lex_keys)))
+            for c in names:
+                out_cols[c] = out_cols[c][order]
+                out_nulls[c] = out_nulls[c][order]
+        # OFFSET / LIMIT
+        lo = plan.offset or 0
+        hi = n if plan.limit is None else min(n, lo + plan.limit)
+        if lo or hi < n:
+            for c in names:
+                out_cols[c] = out_cols[c][lo:hi]
+                out_nulls[c] = out_nulls[c][lo:hi]
+        final_n = max(0, hi - lo)
+
+        # surface NULLs as None in object columns
+        for c in names:
+            if out_nulls[c].any():
+                col = out_cols[c]
+                out_cols[c] = np.array(
+                    [None if nm else v for v, nm in zip(col, out_nulls[c])],
+                    dtype=object)
+        return ResultSet(names, out_cols, final_n)
+
+    @staticmethod
+    def _unique_name(name: str, taken: list[str]) -> str:
+        if name not in taken:
+            return name
+        i = 1
+        while f"{name}_{i}" in taken:
+            i += 1
+        return f"{name}_{i}"
+
+

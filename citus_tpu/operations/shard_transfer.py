@@ -1,0 +1,49 @@
+"""Shard placement moves.
+
+The reference moves shard groups between workers with logical replication +
+catch-up + metadata flip (operations/shard_transfer.c,
+citus_move_shard_placement).  Here tables are immutable stripe sets, so a
+move is: copy/relink stripe files (no-op within one host store), flip the
+placement row, mark the old placement for deferred cleanup
+(pg_dist_cleanup analogue) — no replication machinery needed.
+"""
+
+from __future__ import annotations
+
+from ..catalog import Catalog, ShardPlacement
+from ..errors import CatalogError
+from ..storage import TableStore
+
+
+def move_shard_placement(catalog: Catalog, store: TableStore,
+                         shard_id: int, target_node_name: str,
+                         colocated: bool = True) -> list[int]:
+    """Move a shard (and its colocated siblings) to another node.
+
+    Returns the shard ids moved.  Storage is shared within a single-host
+    store, so only placements change; the stripe files stay in place.
+    """
+    if shard_id not in catalog.shards:
+        raise CatalogError(f"shard {shard_id} does not exist")
+    target = catalog.node_by_name(target_node_name)
+    shard = catalog.shards[shard_id]
+    to_move = [shard]
+    if colocated and shard.min_value is not None:
+        table_meta = catalog.table(shard.table_name)
+        for other_name in catalog.colocated_tables(shard.table_name):
+            if other_name == shard.table_name:
+                continue
+            sibling = catalog.table_shards(other_name)[shard.shard_index]
+            to_move.append(sibling)
+    moved = []
+    for s in to_move:
+        placement = catalog.active_placement(s.shard_id)
+        if placement.node_id == target.node_id:
+            continue
+        # deferred cleanup record: old placement lingers as to_delete
+        placement.shard_state = "to_delete"
+        catalog.placements[catalog.allocate_placement_id()] = ShardPlacement(
+            catalog._next_placement_id - 1, s.shard_id, target.node_id)
+        moved.append(s.shard_id)
+    catalog._bump()
+    return moved

@@ -56,13 +56,11 @@ def sort_build_side(build_keys: list[jnp.ndarray], build_valid: jnp.ndarray,
     return sorted_keys, order, n_valid
 
 
-def lower_bound(sorted_keys: list[jnp.ndarray], n_valid: jnp.ndarray,
-                probe_keys: list[jnp.ndarray]) -> jnp.ndarray:
-    """Vectorized lexicographic lower_bound over the sorted build side.
-
-    Returns, per probe row, the first index in [0, n_valid] whose key is
-    >= the probe key.  ceil(log2(M))+1 fixed iterations (static shape).
-    """
+def _search(sorted_keys: list[jnp.ndarray], n_valid: jnp.ndarray,
+            probe_keys: list[jnp.ndarray], cmp) -> jnp.ndarray:
+    """Vectorized binary search: first index in [0, n_valid] where
+    cmp(build_key, probe_key) is False.  cmp must be monotone (True then
+    False over the sorted build).  ceil(log2(M))+1 fixed iterations."""
     m = sorted_keys[0].shape[0]
     n = probe_keys[0].shape[0]
     steps = max(1, math.ceil(math.log2(m + 1)))
@@ -75,13 +73,19 @@ def lower_bound(sorted_keys: list[jnp.ndarray], n_valid: jnp.ndarray,
         mid = (lo + hi) // 2
         mid_c = jnp.clip(mid, 0, m - 1)
         mid_keys = [k[mid_c] for k in sorted_keys]
-        less = _lex_less(mid_keys, probe_keys)
-        lo = jnp.where(active & less, mid + 1, lo)
-        hi = jnp.where(active & ~less, mid, hi)
+        take = cmp(mid_keys, probe_keys)
+        lo = jnp.where(active & take, mid + 1, lo)
+        hi = jnp.where(active & ~take, mid, hi)
         return lo, hi
 
     lo, hi = jax.lax.fori_loop(0, steps, body, (lo, hi))
     return lo
+
+
+def lower_bound(sorted_keys: list[jnp.ndarray], n_valid: jnp.ndarray,
+                probe_keys: list[jnp.ndarray]) -> jnp.ndarray:
+    """First index with key >= probe (lexicographic, exact)."""
+    return _search(sorted_keys, n_valid, probe_keys, _lex_less)
 
 
 def lookup_join(build_keys: list[jnp.ndarray], build_valid: jnp.ndarray,
@@ -110,28 +114,17 @@ def match_counts(build_keys: list[jnp.ndarray], build_valid: jnp.ndarray,
     sorted_keys, _, n_valid = sort_build_side(build_keys, build_valid)
     lo = lower_bound(sorted_keys, n_valid, probe_keys)
     hi = _upper_bound(sorted_keys, n_valid, probe_keys)
-    return jnp.where(probe_valid, jnp.maximum(hi - lo, 0), 0)
+    return jnp.where(probe_valid, hi - lo, 0)
+
+
+def _lex_leq(a: list[jnp.ndarray], b: list[jnp.ndarray]) -> jnp.ndarray:
+    return ~_lex_less(b, a)
 
 
 def _upper_bound(sorted_keys, n_valid, probe_keys):
-    """First index with key > probe: lower_bound of (probe, last_col+1).
-
-    Integer keys only: for floats, +1 is not "next representable value"
-    (3e8f + 1 == 3e8f) and ranges would be wrong.  The planner only emits
-    integer join keys (ints, dates, dictionary codes).
-
-    The +1 wraps at the dtype max; those lanes fall back to n_valid (every
-    remaining key compares equal-or-less), which the max(hi-lo, 0) clamp in
-    callers keeps sound."""
-    last = probe_keys[-1]
-    if not jnp.issubdtype(last.dtype, jnp.integer):
-        raise TypeError(
-            f"multi-match join keys must be integers, got {last.dtype}; "
-            "cast float keys at plan time")
-    bumped_last = last + 1
-    wrapped = bumped_last < last
-    hi = lower_bound(sorted_keys, n_valid, probe_keys[:-1] + [bumped_last])
-    return jnp.where(wrapped, jnp.broadcast_to(n_valid, hi.shape), hi)
+    """First index with key > probe — a direct search with <=, exact for
+    any key dtype and any extreme values (no '+1 bump' tricks)."""
+    return _search(sorted_keys, n_valid, probe_keys, _lex_leq)
 
 
 def expand_join(build_keys: list[jnp.ndarray], build_valid: jnp.ndarray,
@@ -148,7 +141,7 @@ def expand_join(build_keys: list[jnp.ndarray], build_valid: jnp.ndarray,
     sorted_keys, order, n_valid = sort_build_side(build_keys, build_valid)
     lo = lower_bound(sorted_keys, n_valid, probe_keys)
     hi = _upper_bound(sorted_keys, n_valid, probe_keys)
-    counts = jnp.where(probe_valid, jnp.maximum(hi - lo, 0), 0)
+    counts = jnp.where(probe_valid, hi - lo, 0)
     total = counts.sum()
     starts = jnp.cumsum(counts) - counts  # exclusive prefix
 

@@ -1,0 +1,7 @@
+from . import expr
+from .bind import Binder, BoundQuery, DictProvider
+from .explain import format_plan
+from .plan import DistributedPlanner, QueryPlan, StatsProvider
+
+__all__ = ["expr", "Binder", "BoundQuery", "DictProvider", "format_plan",
+           "DistributedPlanner", "QueryPlan", "StatsProvider"]

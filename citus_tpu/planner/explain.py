@@ -1,0 +1,76 @@
+"""EXPLAIN output: render the distributed plan tree.
+
+The analogue of the reference's distributed EXPLAIN
+(planner/multi_explain.c:215 RemoteExplain) — but there are no remote
+per-task plans to fetch: the strategy annotations ARE the execution plan,
+and EXPLAIN ANALYZE appends wall-clock + retry stats from the runner.
+"""
+
+from __future__ import annotations
+
+from ..catalog import Catalog
+from .plan import AggregateNode, JoinNode, PlanNode, ProjectNode, QueryPlan, ScanNode
+
+_JOIN_LABEL = {
+    "local": "Colocated Join",
+    "broadcast": "Broadcast Join",
+    "repart_right": "Repartition Join (single: right)",
+    "repart_left": "Repartition Join (single: left)",
+    "repart_both": "Repartition Join (dual all_to_all)",
+}
+
+
+def format_plan(plan: QueryPlan, catalog: Catalog) -> list[str]:
+    lines = [f"Distributed Query  (devices: {plan.n_devices})"]
+    if plan.host_order_by or plan.limit is not None or plan.host_having:
+        combine = ["Host Combine:"]
+        if plan.host_having is not None:
+            combine.append(f"having {plan.host_having}")
+        if plan.host_order_by:
+            keys = ", ".join(f"{e}{' DESC' if d else ''}"
+                             for e, d, _ in plan.host_order_by)
+            combine.append(f"order by {keys}")
+        if plan.limit is not None:
+            combine.append(f"limit {plan.limit}")
+        lines.append("  " + "  ".join(combine))
+    _format_node(plan.root, lines, 1)
+    return lines
+
+
+def _format_node(node: PlanNode, lines: list[str], depth: int) -> None:
+    pad = "  " * depth
+    if isinstance(node, ScanNode):
+        extra = ""
+        if node.pruned_shards is not None:
+            extra = f"  (shards pruned to {node.pruned_shards})"
+        lines.append(f"{pad}-> Columnar Scan on {node.rel.table} "
+                     f"[{node.dist.kind}]{extra}")
+        if node.filter is not None:
+            lines.append(f"{pad}     Filter: {node.filter}")
+        return
+    if isinstance(node, ProjectNode):
+        exprs = ", ".join(f"{e} AS {cid}" for e, cid in node.exprs)
+        lines.append(f"{pad}-> Project [{exprs}]")
+        _format_node(node.input, lines, depth + 1)
+        return
+    if isinstance(node, JoinNode):
+        label = _JOIN_LABEL.get(node.strategy, node.strategy)
+        conds = ", ".join(f"{l} = {r}" for l, r in
+                          zip(node.left_keys, node.right_keys))
+        lines.append(f"{pad}-> {label} on ({conds})")
+        if node.residual is not None:
+            lines.append(f"{pad}     Residual: {node.residual}")
+        _format_node(node.left, lines, depth + 1)
+        _format_node(node.right, lines, depth + 1)
+        return
+    if isinstance(node, AggregateNode):
+        combine = {"local": "device-local groups",
+                   "global": "psum combine",
+                   "repartition": "all_to_all combine"}[node.combine]
+        keys = ", ".join(str(g) for g, _ in node.group_keys) or "()"
+        aggs = ", ".join(str(a) for a, _ in node.aggs)
+        lines.append(f"{pad}-> GroupAggregate [{combine}] "
+                     f"keys: {keys}  aggs: {aggs}")
+        _format_node(node.input, lines, depth + 1)
+        return
+    lines.append(f"{pad}-> {type(node).__name__}")

@@ -1,0 +1,239 @@
+"""Bound expression IR: what the planner emits and executors evaluate.
+
+The AST (citus_tpu.sql.ast) carries names; this IR carries *resolved*
+references (unique column ids + dtypes) and is backend-agnostic: the same
+tree is evaluated with jax.numpy on device and numpy on host (final HAVING/
+ORDER BY), the analogue of the reference evaluating quals both on workers
+and in the combine query on the coordinator
+(planner/multi_logical_optimizer.c worker/master split).
+
+SQL three-valued logic: every evaluation returns (values, null_mask);
+WHERE treats NULL as false.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from ..types import DataType
+
+
+class BExpr:
+    """Bound expression base. All subclasses are frozen/hashable."""
+
+    dtype: DataType
+
+
+@dataclass(frozen=True)
+class BCol(BExpr):
+    """Resolved column: `cid` is the unique column id in executor Blocks
+    (e.g. "0.l_orderkey" = range-table index 0, column l_orderkey)."""
+
+    cid: str
+    dtype: DataType
+    # provenance for planning decisions (pruning, colocation):
+    table: str = ""
+    column: str = ""
+    rel_index: int = -1
+
+    def __str__(self):
+        return self.cid
+
+
+@dataclass(frozen=True)
+class BConst(BExpr):
+    value: object  # python scalar; dict-encoded strings already as int codes
+    dtype: DataType
+
+    def __str__(self):
+        return repr(self.value)
+
+
+@dataclass(frozen=True)
+class BArith(BExpr):
+    op: str  # + - * / %
+    left: BExpr
+    right: BExpr
+    dtype: DataType
+
+    def __str__(self):
+        return f"({self.left} {self.op} {self.right})"
+
+
+@dataclass(frozen=True)
+class BCmp(BExpr):
+    op: str  # = <> < <= > >=
+    left: BExpr
+    right: BExpr
+    dtype: DataType = DataType.BOOL
+
+    def __str__(self):
+        return f"({self.left} {self.op} {self.right})"
+
+
+@dataclass(frozen=True)
+class BBool(BExpr):
+    op: str  # AND OR NOT
+    args: tuple[BExpr, ...]
+    dtype: DataType = DataType.BOOL
+
+    def __str__(self):
+        if self.op == "NOT":
+            return f"(NOT {self.args[0]})"
+        return "(" + f" {self.op} ".join(map(str, self.args)) + ")"
+
+
+@dataclass(frozen=True)
+class BIsNull(BExpr):
+    operand: BExpr
+    negated: bool = False
+    dtype: DataType = DataType.BOOL
+
+    def __str__(self):
+        return f"({self.operand} IS {'NOT ' if self.negated else ''}NULL)"
+
+
+@dataclass(frozen=True)
+class BInConst(BExpr):
+    """operand IN (constant set) — string predicates lower to code sets."""
+
+    operand: BExpr
+    values: tuple  # python scalars, same space as operand
+    negated: bool = False
+    dtype: DataType = DataType.BOOL
+
+    def __str__(self):
+        neg = "NOT " if self.negated else ""
+        return f"({self.operand} {neg}IN {self.values})"
+
+
+@dataclass(frozen=True)
+class BCase(BExpr):
+    whens: tuple[tuple[BExpr, BExpr], ...]
+    else_result: Optional[BExpr]
+    dtype: DataType
+
+    def __str__(self):
+        parts = " ".join(f"WHEN {c} THEN {r}" for c, r in self.whens)
+        return f"CASE {parts} ELSE {self.else_result} END"
+
+
+@dataclass(frozen=True)
+class BCast(BExpr):
+    operand: BExpr
+    dtype: DataType
+
+    def __str__(self):
+        return f"CAST({self.operand} AS {self.dtype.value})"
+
+
+@dataclass(frozen=True)
+class BExtract(BExpr):
+    part: str  # year | month | day
+    operand: BExpr
+    dtype: DataType = DataType.INT32
+
+    def __str__(self):
+        return f"EXTRACT({self.part} FROM {self.operand})"
+
+
+@dataclass(frozen=True)
+class BAgg(BExpr):
+    """Aggregate call; appears only in Aggregate plan nodes."""
+
+    kind: str            # sum | count | avg | min | max | count_star
+    arg: Optional[BExpr]  # None for count(*)
+    distinct: bool = False
+    dtype: DataType = DataType.FLOAT64
+
+    def __str__(self):
+        if self.kind == "count_star":
+            return "count(*)"
+        d = "DISTINCT " if self.distinct else ""
+        return f"{self.kind}({d}{self.arg})"
+
+
+def expr_columns(e: BExpr) -> set[str]:
+    """All BCol cids referenced."""
+    out: set[str] = set()
+
+    def rec(x):
+        if isinstance(x, BCol):
+            out.add(x.cid)
+        for c in children(x):
+            rec(c)
+
+    rec(e)
+    return out
+
+
+def children(e: BExpr) -> tuple:
+    if isinstance(e, (BArith, BCmp)):
+        return (e.left, e.right)
+    if isinstance(e, BBool):
+        return e.args
+    if isinstance(e, (BIsNull, BCast, BExtract)):
+        return (e.operand,)
+    if isinstance(e, BInConst):
+        return (e.operand,)
+    if isinstance(e, BCase):
+        out: tuple = ()
+        for c, r in e.whens:
+            out += (c, r)
+        if e.else_result is not None:
+            out += (e.else_result,)
+        return out
+    if isinstance(e, BAgg):
+        return (e.arg,) if e.arg is not None else ()
+    return ()
+
+
+def walk(e: BExpr):
+    yield e
+    for c in children(e):
+        yield from walk(c)
+
+
+def contains_agg(e: BExpr) -> bool:
+    return any(isinstance(x, BAgg) for x in walk(e))
+
+
+def split_conjuncts(e: BExpr | None) -> list[BExpr]:
+    if e is None:
+        return []
+    if isinstance(e, BBool) and e.op == "AND":
+        out = []
+        for a in e.args:
+            out.extend(split_conjuncts(a))
+        return out
+    return [e]
+
+
+def make_and(conjuncts: list[BExpr]) -> BExpr | None:
+    if not conjuncts:
+        return None
+    if len(conjuncts) == 1:
+        return conjuncts[0]
+    return BBool("AND", tuple(conjuncts))
+
+
+# numeric type promotion ----------------------------------------------------
+
+_RANK = {DataType.BOOL: 0, DataType.INT32: 1, DataType.DATE: 1,
+         DataType.INT64: 2, DataType.FLOAT32: 3, DataType.FLOAT64: 4}
+
+
+def promote(a: DataType, b: DataType) -> DataType:
+    if a == b:
+        return a
+    if a == DataType.STRING or b == DataType.STRING:
+        from ..errors import PlanningError
+
+        raise PlanningError(f"no arithmetic on string types ({a} vs {b})")
+    # date - date → int; date +/- int handled in binder
+    ra, rb = _RANK[a], _RANK[b]
+    hi = a if ra >= rb else b
+    if hi == DataType.DATE:
+        hi = DataType.INT32
+    return hi

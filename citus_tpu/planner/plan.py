@@ -1,0 +1,588 @@
+"""Distributed plan nodes + the join-order/strategy planner.
+
+Mirrors the reference's planning cascade pieces:
+
+* join-order search with rule preferences — multi_join_order.c:286
+  JoinOrderList / BestJoinOrder (reference rules REFERENCE_JOIN,
+  LOCAL_PARTITION_JOIN, SINGLE_{HASH,RANGE}_PARTITION_JOIN,
+  DUAL_PARTITION_JOIN, CARTESIAN_PRODUCT → here BROADCAST, LOCAL,
+  REPART_LEFT/REPART_RIGHT, REPART_BOTH, CARTESIAN)
+* worker/master aggregate split — multi_logical_optimizer.c:1419 (here:
+  partial aggregation per device + LOCAL / GLOBAL-psum / REPARTITION
+  combine strategies)
+* physical Job/MapMergeJob tree — multi_physical_planner.c:274 (here the
+  strategy annotations compile into one shard_map program whose
+  repartition stages are all_to_all collectives instead of map/fetch
+  tasks)
+
+A node's `dist` describes how its rows are spread over the mesh —
+the placement-map equality check is the colocation test
+(colocation_utils.c analogue).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..catalog import Catalog, DistributionMethod
+from ..errors import PlanningError
+from ..types import DataType
+from . import expr as ir
+from .bind import BoundQuery, BoundRel
+
+
+# --------------------------------------------------------------------------
+# distribution descriptors
+# --------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class Dist:
+    """How a node's rows map onto devices.
+
+    kind: 'hash' (token-range sharded), 'replicated' (every device has all
+    rows), 'device' (hash-partitioned directly to n_dev buckets after a
+    repartition).
+    cids: columns (equivalence set) the rows are partitioned by.
+    shard_count / placement: token-space split + shard→device map; for
+    kind='device', shard_count == n_devices and placement is identity.
+    """
+
+    kind: str
+    cids: frozenset[str] = frozenset()
+    shard_count: int = 0
+    placement: tuple[int, ...] = ()
+
+    def colocated_with(self, other: "Dist") -> bool:
+        return (self.kind in ("hash", "device")
+                and other.kind in ("hash", "device")
+                and self.shard_count == other.shard_count
+                and self.placement == other.placement)
+
+
+# --------------------------------------------------------------------------
+# plan nodes
+# --------------------------------------------------------------------------
+
+@dataclass
+class PlanNode:
+    dist: Dist = field(default=None, init=False)  # type: ignore
+    out_columns: dict[str, DataType] = field(default_factory=dict, init=False)
+    est_rows: int = field(default=0, init=False)
+
+
+@dataclass
+class ScanNode(PlanNode):
+    rel: BoundRel
+    filter: Optional[ir.BExpr]
+    columns: list[str]               # cids to load
+    pruned_shards: Optional[list[int]] = None  # shard indices after pruning
+
+
+@dataclass
+class JoinNode(PlanNode):
+    strategy: str  # local | broadcast | repart_left | repart_right | repart_both | cartesian
+    left: PlanNode
+    right: PlanNode
+    left_keys: list[ir.BExpr]
+    right_keys: list[ir.BExpr]
+    residual: Optional[ir.BExpr] = None
+    # for repart_left/right: index of the key pair aligned with the
+    # partner's distribution column — the shuffle hashes ONLY that key
+    # (hashing all keys would route rows off the partner's shards)
+    repart_key_idx: int = 0
+
+
+@dataclass
+class AggregateNode(PlanNode):
+    combine: str  # local | global | repartition
+    input: PlanNode
+    group_keys: list[tuple[ir.BExpr, str]]      # (expr, out cid)
+    aggs: list[tuple[ir.BAgg, str]]             # (agg, out cid)
+
+
+@dataclass
+class ProjectNode(PlanNode):
+    input: PlanNode
+    exprs: list[tuple[ir.BExpr, str]]           # (expr, out cid)
+
+
+# --------------------------------------------------------------------------
+# planner context
+# --------------------------------------------------------------------------
+
+class StatsProvider:
+    """Row counts for capacity planning (shard_size/row metadata analogue,
+    metadata/metadata_utility.c)."""
+
+    def table_rows(self, table: str) -> int:  # pragma: no cover
+        raise NotImplementedError
+
+
+@dataclass
+class QueryPlan:
+    """Device plan + the host-side combine phase
+    (combine_query_planner.c analogue)."""
+
+    root: PlanNode
+    n_devices: int
+    # host phase — exprs over the device plan's output cids:
+    host_select: list[tuple[ir.BExpr, str]]     # (expr, output name)
+    host_having: Optional[ir.BExpr]
+    host_order_by: list[tuple[ir.BExpr, bool, bool | None]]
+    limit: Optional[int]
+    offset: Optional[int]
+    # cid → (table, column) for dictionary decode of string outputs
+    decode: dict[str, tuple[str, str]]
+    catalog_version: int = 0
+
+
+class DistributedPlanner:
+    def __init__(self, catalog: Catalog, stats: StatsProvider,
+                 n_devices: int, enable_repartition: bool = True):
+        self.catalog = catalog
+        self.stats = stats
+        self.n_devices = n_devices
+        self.enable_repartition = enable_repartition
+
+    # -- table dist --------------------------------------------------------
+    def _table_dist(self, rel: BoundRel) -> Dist:
+        meta = self.catalog.table(rel.table)
+        if meta.method == DistributionMethod.REFERENCE:
+            return Dist("replicated")
+        if meta.method == DistributionMethod.LOCAL:
+            # controller-local tables are fed replicated for now
+            return Dist("replicated")
+        shards = self.catalog.table_shards(rel.table)
+        placement = tuple(
+            (self.catalog.active_placement(s.shard_id).node_id - 1)
+            % self.n_devices for s in shards)
+        return Dist("hash",
+                    frozenset({rel.cid(meta.distribution_column)}),
+                    len(shards), placement)
+
+    def device_dist(self, cids: frozenset[str]) -> Dist:
+        return Dist("device", cids, self.n_devices,
+                    tuple(range(self.n_devices)))
+
+    # -- entry -------------------------------------------------------------
+    def plan(self, q: BoundQuery) -> QueryPlan:
+        needed = self._collect_needed_columns(q)
+        scans = {}
+        for rel in q.rels:
+            cols = sorted(needed.get(rel.rel_index, set()))
+            scans[rel.rel_index] = self._make_scan(rel, cols, q.conjuncts)
+
+        joined = self._plan_joins(q, scans)
+
+        decode: dict[str, tuple[str, str]] = {}
+        if q.is_aggregate or q.distinct:
+            root, host_select, having = self._plan_aggregate(q, joined, decode)
+        else:
+            root, host_select = self._plan_projection(q, joined, decode)
+            having = None
+
+        host_order = self._rewrite_order_by(q, host_select)
+        return QueryPlan(root=root, n_devices=self.n_devices,
+                         host_select=host_select, host_having=having,
+                         host_order_by=host_order, limit=q.limit,
+                         offset=q.offset, decode=decode,
+                         catalog_version=self.catalog.version)
+
+    # -- column collection -------------------------------------------------
+    def _collect_needed_columns(self, q: BoundQuery) -> dict[int, set[str]]:
+        needed: dict[int, set[str]] = {}
+
+        def visit(e: ir.BExpr):
+            for node in ir.walk(e):
+                if isinstance(node, ir.BCol):
+                    needed.setdefault(node.rel_index, set()).add(node.cid)
+
+        for c in q.conjuncts:
+            visit(c)
+        for e, _ in q.select:
+            visit(e)
+        for g in q.group_by:
+            visit(g)
+        if q.having is not None:
+            visit(q.having)
+        for e, _, _ in q.order_by:
+            visit(e)
+        return needed
+
+    # -- scans + filter pushdown ------------------------------------------
+    def _make_scan(self, rel: BoundRel, cols: list[str],
+                   conjuncts: list[ir.BExpr]) -> ScanNode:
+        local = []
+        for c in conjuncts:
+            rels = {n.rel_index for n in ir.walk(c) if isinstance(n, ir.BCol)}
+            # subset includes the empty set: constant predicates (WHERE
+            # false, folded empty-IN-subquery) attach to every scan
+            if rels <= {rel.rel_index}:
+                local.append(c)
+        node = ScanNode(rel=rel, filter=ir.make_and(local), columns=cols)
+        node.dist = self._table_dist(rel)
+        node.est_rows = max(1, self.stats.table_rows(rel.table))
+        node.out_columns = {}
+        for cid in cols:
+            col = rel.schema.column(cid.split(".", 1)[1])
+            node.out_columns[cid] = col.dtype
+        node.pruned_shards = self._prune_shards(rel, local)
+        return node
+
+    def _prune_shards(self, rel: BoundRel,
+                      filters: list[ir.BExpr]) -> Optional[list[int]]:
+        """Equality/IN on the distribution column → shard list
+        (PruneShards analogue, planner/shard_pruning.c:304 — hash
+        distribution prunes on equality only)."""
+        meta = self.catalog.table(rel.table)
+        if meta.method != DistributionMethod.HASH:
+            return None
+        from ..catalog.distribution import hash_token, shard_index_for_token
+        import numpy as np
+
+        dist_cid = rel.cid(meta.distribution_column)
+        dtype = meta.schema.column(meta.distribution_column).dtype
+        candidates: Optional[set[int]] = None
+        for f in filters:
+            values = None
+            if (isinstance(f, ir.BCmp) and f.op == "="
+                    and isinstance(f.left, ir.BCol) and f.left.cid == dist_cid
+                    and isinstance(f.right, ir.BConst)
+                    and f.right.value is not None):
+                values = [f.right.value]
+            elif (isinstance(f, ir.BInConst) and not f.negated
+                    and isinstance(f.operand, ir.BCol)
+                    and f.operand.cid == dist_cid):
+                values = list(f.values)
+            if values is None:
+                continue
+            arr = np.asarray(values, dtype=dtype.numpy_dtype)
+            idx = set(int(i) for i in shard_index_for_token(
+                hash_token(arr), len(self.catalog.table_shards(rel.table))))
+            candidates = idx if candidates is None else (candidates & idx)
+        return sorted(candidates) if candidates is not None else None
+
+    # -- join order + strategies ------------------------------------------
+    def _plan_joins(self, q: BoundQuery,
+                    scans: dict[int, ScanNode]) -> PlanNode:
+        if len(scans) == 1:
+            return next(iter(scans.values()))
+
+        # classify cross-rel conjuncts into equi-join edges vs residuals
+        edges = []      # (rel_set, left_expr, right_expr)
+        residuals = []  # (rel_set, expr)
+        for c in q.conjuncts:
+            rels = {n.rel_index for n in ir.walk(c) if isinstance(n, ir.BCol)}
+            if len(rels) <= 1:
+                continue
+            if (isinstance(c, ir.BCmp) and c.op == "=" and len(rels) == 2):
+                lrels = {n.rel_index for n in ir.walk(c.left)
+                         if isinstance(n, ir.BCol)}
+                rrels = {n.rel_index for n in ir.walk(c.right)
+                         if isinstance(n, ir.BCol)}
+                if len(lrels) == 1 and len(rrels) == 1 and lrels != rrels:
+                    edges.append((frozenset(rels), c.left, c.right))
+                    continue
+            residuals.append((frozenset(rels), c))
+
+        # greedy left-deep order: start from the largest relation
+        # (BestJoinOrder starts from the largest table too)
+        remaining = dict(scans)
+        start = max(remaining, key=lambda r: remaining[r].est_rows)
+        current = remaining.pop(start)
+        placed = {start}
+        pending_edges = list(edges)
+        pending_residuals = list(residuals)
+
+        while remaining:
+            best = None  # (rank, rel_index, join_edges)
+            for ri, scan in remaining.items():
+                join_edges = [e for e in pending_edges
+                              if e[0] <= (placed | {ri})
+                              and ri in e[0]]
+                strategy = self._choose_strategy(current, scan, join_edges)
+                rank = _STRATEGY_RANK[strategy]
+                size = scan.est_rows
+                key = (rank, size, ri)
+                if best is None or key < best[0]:
+                    best = (key, ri, join_edges, strategy)
+            _, ri, join_edges, strategy = best
+            right = remaining.pop(ri)
+            placed.add(ri)
+            pending_edges = [e for e in pending_edges if e not in join_edges]
+            current = self._make_join(current, right, join_edges, strategy, ri)
+            # attach residuals once all their rels are placed
+            ready = [r for r in pending_residuals if r[0] <= placed]
+            if ready:
+                pending_residuals = [r for r in pending_residuals
+                                     if r not in ready]
+                res = ir.make_and([r[1] for r in ready])
+                existing = current.residual
+                current.residual = (res if existing is None
+                                    else ir.make_and([existing, res]))
+        return current
+
+    def _choose_strategy(self, left: PlanNode, right: ScanNode,
+                         join_edges) -> str:
+        if not join_edges:
+            # keyless join: only viable against a replicated side, and
+            # ranked last so edge-connected relations join first
+            if right.dist.kind == "replicated" or \
+                    left.dist.kind == "replicated":
+                return "cartesian_broadcast"
+            return "cartesian"
+        if right.dist.kind == "replicated":
+            return "broadcast"
+        if left.dist.kind == "replicated":
+            # left replicated, right sharded: join runs devicewise against
+            # right's shards; result inherits right's distribution
+            return "broadcast_left"
+        # per-edge alignment with each side's partition columns: a join can
+        # run locally / with a single repartition only through ONE edge
+        # whose key matches the partition column (multi-edge joins like
+        # Q5's customer ⋈ {orders,supplier} on (custkey, nationkey) must
+        # not hash the extra keys into the routing)
+        edge_align = []  # (left_aligned, right_aligned) per edge
+        for _, a, b in join_edges:
+            a_rels = {n.rel_index for n in ir.walk(a) if isinstance(n, ir.BCol)}
+            if a_rels == {right.rel.rel_index}:
+                r_e = {n.cid for n in ir.walk(a) if isinstance(n, ir.BCol)}
+                l_e = {n.cid for n in ir.walk(b) if isinstance(n, ir.BCol)}
+            else:
+                l_e = {n.cid for n in ir.walk(a) if isinstance(n, ir.BCol)}
+                r_e = {n.cid for n in ir.walk(b) if isinstance(n, ir.BCol)}
+            edge_align.append((bool(left.dist.cids & l_e),
+                               bool(right.dist.cids & r_e)))
+        if any(la and ra for la, ra in edge_align) and \
+                left.dist.colocated_with(right.dist):
+            return "local"
+        if not self.enable_repartition:
+            raise PlanningError(
+                "the query requires repartitioning, but "
+                "enable_repartition_joins is off")
+        if any(la for la, _ in edge_align):
+            return "repart_right"
+        if any(ra for _, ra in edge_align):
+            return "repart_left"
+        return "repart_both"
+
+    def _make_join(self, left: PlanNode, right: ScanNode, join_edges,
+                   strategy: str, right_rel_index: int) -> JoinNode:
+        left_keys, right_keys = [], []
+        for _, a, b in join_edges:
+            a_rels = {n.rel_index for n in ir.walk(a) if isinstance(n, ir.BCol)}
+            if a_rels == {right_rel_index}:
+                right_keys.append(a)
+                left_keys.append(b)
+            else:
+                left_keys.append(a)
+                right_keys.append(b)
+        if strategy == "cartesian_broadcast":
+            # keyless product against a replicated relation: put the
+            # replicated side on the build (right) side
+            if right.dist.kind == "replicated":
+                node = JoinNode(strategy="broadcast", left=left, right=right,
+                                left_keys=[], right_keys=[])
+                node.dist = left.dist
+            else:
+                node = JoinNode(strategy="broadcast", left=right, right=left,
+                                left_keys=[], right_keys=[])
+                node.dist = right.dist
+            node.est_rows = max(left.est_rows, right.est_rows)
+            node.out_columns = {**left.out_columns, **right.out_columns}
+            return node
+        if strategy == "broadcast_left":
+            # swap so the replicated side is the broadcast (right) side
+            node = JoinNode(strategy="broadcast", left=right, right=left,
+                            left_keys=right_keys, right_keys=left_keys)
+            node.dist = right.dist
+        else:
+            node = JoinNode(strategy=strategy, left=left, right=right,
+                            left_keys=left_keys, right_keys=right_keys)
+        # per-edge cid sets, index-aligned with left_keys/right_keys
+        edge_lcids = [frozenset(n.cid for n in ir.walk(e)
+                                if isinstance(n, ir.BCol))
+                      for e in left_keys]
+        edge_rcids = [frozenset(n.cid for n in ir.walk(e)
+                                if isinstance(n, ir.BCol))
+                      for e in right_keys]
+
+        def extend_cids(base: frozenset) -> frozenset:
+            # equality edges propagate partition-column membership:
+            # if one side of an edge is a partition col, so is the other
+            out = set(base)
+            changed = True
+            while changed:
+                changed = False
+                for lc, rc in zip(edge_lcids, edge_rcids):
+                    if (lc & out) and not (rc <= out):
+                        out |= rc
+                        changed = True
+                    if (rc & out) and not (lc <= out):
+                        out |= lc
+                        changed = True
+            return frozenset(out)
+
+        if strategy == "local":
+            node.dist = Dist(left.dist.kind, extend_cids(left.dist.cids),
+                             left.dist.shard_count, left.dist.placement)
+        elif strategy == "broadcast":
+            node.dist = left.dist
+        elif strategy == "broadcast_left":
+            pass  # set above
+        elif strategy == "repart_right":
+            node.repart_key_idx = next(
+                i for i, lc in enumerate(edge_lcids)
+                if lc & left.dist.cids)
+            node.dist = Dist(left.dist.kind, extend_cids(left.dist.cids),
+                             left.dist.shard_count, left.dist.placement)
+        elif strategy == "repart_left":
+            node.repart_key_idx = next(
+                i for i, rc in enumerate(edge_rcids)
+                if rc & right.dist.cids)
+            node.dist = Dist(right.dist.kind, extend_cids(right.dist.cids),
+                             right.dist.shard_count, right.dist.placement)
+        elif strategy == "repart_both":
+            node.dist = self.device_dist(
+                frozenset().union(*edge_lcids, *edge_rcids))
+        elif strategy == "cartesian":
+            raise PlanningError(
+                "cartesian products are not supported (add a join clause)")
+        node.est_rows = max(left.est_rows, right.est_rows)
+        node.out_columns = {**left.out_columns, **right.out_columns}
+        return node
+
+    # -- aggregation -------------------------------------------------------
+    def _plan_aggregate(self, q: BoundQuery, input_node: PlanNode,
+                        decode: dict):
+        # rewrite select/having/order exprs: BAgg → BCol("aggN"); group
+        # exprs → BCol("gN")
+        group_keys: list[tuple[ir.BExpr, str]] = []
+        group_map: dict[ir.BExpr, ir.BCol] = {}
+        if q.distinct and not q.is_aggregate:
+            # SELECT DISTINCT x, y = group by all select items
+            items = [e for e, _ in q.select]
+        else:
+            items = q.group_by
+        for i, g in enumerate(items):
+            cid = f"g{i}"
+            group_keys.append((g, cid))
+            group_map[g] = ir.BCol(cid, g.dtype)
+            if isinstance(g, ir.BCol) and g.dtype == DataType.STRING:
+                decode[cid] = (g.table, g.column)
+
+        aggs: list[tuple[ir.BAgg, str]] = []
+        agg_map: dict[ir.BAgg, ir.BExpr] = {}
+
+        def register_agg(a: ir.BAgg) -> ir.BExpr:
+            if a in agg_map:
+                return agg_map[a]
+            if a.distinct:
+                raise PlanningError(
+                    "aggregate DISTINCT is not supported yet")
+            if a.kind == "avg":
+                s = register_agg(ir.BAgg("sum", a.arg, False,
+                                         DataType.FLOAT64))
+                c = register_agg(ir.BAgg("count", a.arg, False,
+                                         DataType.INT64))
+                out = ir.BArith("/", s, ir.BCast(c, DataType.FLOAT64),
+                                DataType.FLOAT64)
+            else:
+                cid = f"agg{len(aggs)}"
+                aggs.append((a, cid))
+                out = ir.BCol(cid, a.dtype)
+            agg_map[a] = out
+            return out
+
+        def rewrite(e: ir.BExpr) -> ir.BExpr:
+            if e in group_map:
+                return group_map[e]
+            if isinstance(e, ir.BAgg):
+                return register_agg(e)
+            return _rebuild(e, [rewrite(c) for c in ir.children(e)])
+
+        host_select = [(rewrite(e), name) for e, name in q.select]
+        having = rewrite(q.having) if q.having is not None else None
+
+        node = AggregateNode(
+            combine="", input=input_node,
+            group_keys=group_keys, aggs=aggs)
+        gk_cids = set()
+        for g, _ in group_keys:
+            if isinstance(g, ir.BCol):
+                gk_cids.add(g.cid)
+        if not group_keys:
+            node.combine = "global"
+        elif input_node.dist.kind in ("hash", "device") and \
+                (input_node.dist.cids & gk_cids):
+            node.combine = "local"  # groups already device-disjoint
+        else:
+            node.combine = "repartition"
+        node.dist = (self.device_dist(frozenset(gk_cids))
+                     if node.combine == "repartition" else input_node.dist)
+        node.est_rows = input_node.est_rows
+        node.out_columns = {}
+        for g, cid in group_keys:
+            node.out_columns[cid] = g.dtype
+        for a, cid in aggs:
+            node.out_columns[cid] = a.dtype
+        return node, host_select, having
+
+    def _plan_projection(self, q: BoundQuery, input_node: PlanNode,
+                         decode: dict):
+        exprs = []
+        host_select = []
+        for i, (e, name) in enumerate(q.select):
+            cid = f"p{i}"
+            exprs.append((e, cid))
+            host_select.append((ir.BCol(cid, e.dtype), name))
+            if isinstance(e, ir.BCol) and e.dtype == DataType.STRING:
+                decode[cid] = (e.table, e.column)
+        node = ProjectNode(input=input_node, exprs=exprs)
+        node.dist = input_node.dist
+        node.est_rows = input_node.est_rows
+        node.out_columns = {cid: e.dtype for e, cid in exprs}
+        return node, host_select
+
+    def _rewrite_order_by(self, q: BoundQuery, host_select):
+        # order-by exprs were bound against the same objects as select;
+        # rewrite them in terms of host_select outputs where they match
+        name_by_expr = {}
+        for (orig, name), (rewritten, _) in zip(q.select, host_select):
+            name_by_expr[orig] = rewritten
+        out = []
+        for e, desc, nf in q.order_by:
+            out.append((name_by_expr.get(e, e), desc, nf))
+        return out
+
+
+_STRATEGY_RANK = {"broadcast": 0, "broadcast_left": 0, "local": 1,
+                  "repart_right": 2, "repart_left": 2, "repart_both": 3,
+                  "cartesian_broadcast": 4, "cartesian": 5}
+
+
+def _rebuild(e: ir.BExpr, new_children: list[ir.BExpr]) -> ir.BExpr:
+    if not new_children:
+        return e
+    if isinstance(e, ir.BArith):
+        return ir.BArith(e.op, new_children[0], new_children[1], e.dtype)
+    if isinstance(e, ir.BCmp):
+        return ir.BCmp(e.op, new_children[0], new_children[1])
+    if isinstance(e, ir.BBool):
+        return ir.BBool(e.op, tuple(new_children))
+    if isinstance(e, ir.BIsNull):
+        return ir.BIsNull(new_children[0], e.negated)
+    if isinstance(e, ir.BInConst):
+        return ir.BInConst(new_children[0], e.values, e.negated)
+    if isinstance(e, ir.BCast):
+        return ir.BCast(new_children[0], e.dtype)
+    if isinstance(e, ir.BExtract):
+        return ir.BExtract(e.part, new_children[0])
+    if isinstance(e, ir.BCase):
+        n = len(e.whens)
+        whens = tuple((new_children[2 * i], new_children[2 * i + 1])
+                      for i in range(n))
+        else_r = new_children[2 * n] if len(new_children) > 2 * n else None
+        return ir.BCase(whens, else_r, e.dtype)
+    raise PlanningError(f"cannot rebuild {type(e).__name__}")

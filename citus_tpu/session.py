@@ -1,0 +1,520 @@
+"""Session: the connection-equivalent public API.
+
+Ties the stack together the way the reference's hook layer does
+(shared_library_init.c installing planner/utility hooks): parse → route
+DDL/utility statements to catalog+storage, SELECTs through the planner
+cascade to the distributed executor.
+
+UDF surface parity: `SELECT create_distributed_table('t', 'col')` works
+like the reference's UDFs, alongside the direct Python methods.
+
+Recursive planning (GenerateSubplansForSubqueriesAndCTEs analogue,
+/root/reference/src/backend/distributed/planner/recursive_planning.c:223):
+CTEs, FROM-subqueries, IN/EXISTS/scalar subqueries execute first, bottom-up;
+row results materialize as temporary *reference* tables (the
+read_intermediate_result analogue — broadcast-visible to every device) or
+fold into literals, then the rewritten outer query plans normally.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from dataclasses import replace as dc_replace
+
+import numpy as np
+
+from .catalog import Catalog, DistributionMethod
+from .config import Settings
+from .errors import (
+    CatalogError,
+    ExecutionError,
+    PlanningError,
+    UnsupportedQueryError,
+)
+from .planner.bind import Binder, BoundQuery, DictProvider
+from .planner.explain import format_plan
+from .planner.plan import DistributedPlanner, QueryPlan, StatsProvider
+from .runtime import ensure_jax_configured
+from .sql import ast, parse
+from .storage import TableStore
+from .types import ColumnDef, DataType, TableSchema, sql_type_to_datatype
+
+_UDFS = ("create_distributed_table", "create_reference_table",
+         "citus_add_node", "citus_remove_node", "rebalance_table_shards",
+         "citus_move_shard_placement", "citus_get_node_clock")
+
+
+class _StoreStats(StatsProvider):
+    def __init__(self, store: TableStore):
+        self.store = store
+
+    def table_rows(self, table: str) -> int:
+        return self.store.table_row_count(table)
+
+
+class _StoreDicts(DictProvider):
+    def __init__(self, store: TableStore):
+        self.store = store
+
+    def dictionary(self, table: str, column: str):
+        return self.store.dictionary(table, column)
+
+
+class Session:
+    def __init__(self, data_dir: str | None = None,
+                 n_devices: int | None = None, platform: str | None = None,
+                 **settings):
+        ensure_jax_configured(platform=platform)
+        self.data_dir = data_dir or tempfile.mkdtemp(prefix="citus_tpu_")
+        os.makedirs(self.data_dir, exist_ok=True)
+        self.settings = Settings(settings or None)
+        cat_path = os.path.join(self.data_dir, "catalog.json")
+        self.catalog = (Catalog.load(cat_path) if os.path.exists(cat_path)
+                        else Catalog())
+        self.store = TableStore(self.data_dir, self.catalog)
+        from .distributed.mesh import make_mesh
+
+        self.mesh = make_mesh(n_devices)
+        self.n_devices = len(self.mesh.devices.flatten())
+        if not self.catalog.nodes:
+            for i in range(self.n_devices):
+                self.catalog.add_node(f"device:{i}")
+        self._temp_counter = 0
+        from .executor.runner import Executor
+
+        self.executor = Executor(self.catalog, self.store, self.settings,
+                                 self.mesh)
+
+    # -- public API --------------------------------------------------------
+    def execute(self, sql: str):
+        """Run a SQL script; returns the last statement's ResultSet/None."""
+        result = None
+        for stmt in parse(sql):
+            result = self._execute_statement(stmt)
+        return result
+
+    def create_distributed_table(self, name: str, distribution_column: str,
+                                 shard_count: int | None = None,
+                                 colocate_with: str | None = None):
+        """Convert a (created, still-empty) table into a hash-distributed
+        one — the create_distributed_table UDF analogue
+        (commands/create_distributed_table.c:222)."""
+        meta = self.catalog.table(name)
+        if self.store.table_row_count(name) > 0:
+            raise CatalogError(
+                f"table {name!r} already contains data; distribute before "
+                "loading (data redistribution lands with shard rebalancer)")
+        schema = meta.schema
+        self.catalog.drop_table(name)
+        self.catalog.create_distributed_table(
+            name, schema, distribution_column,
+            shard_count or self.settings.get("shard_count"),
+            colocate_with=colocate_with)
+        self._save_catalog()
+
+    def create_reference_table(self, name: str):
+        meta = self.catalog.table(name)
+        if self.store.table_row_count(name) > 0:
+            raise CatalogError(f"table {name!r} already contains data")
+        schema = meta.schema
+        self.catalog.drop_table(name)
+        self.catalog.create_reference_table(name, schema)
+        self._save_catalog()
+
+    def close(self):
+        self._save_catalog()
+
+    # -- statement dispatch ------------------------------------------------
+    def _execute_statement(self, stmt: ast.Statement):
+        if isinstance(stmt, ast.Select):
+            udf = self._try_udf(stmt)
+            if udf is not None:
+                return udf
+            return self._execute_select(stmt)
+        if isinstance(stmt, ast.CreateTable):
+            return self._execute_create_table(stmt)
+        if isinstance(stmt, ast.DropTable):
+            return self._execute_drop_table(stmt)
+        if isinstance(stmt, ast.InsertValues):
+            return self._execute_insert_values(stmt)
+        if isinstance(stmt, ast.InsertSelect):
+            return self._execute_insert_select(stmt)
+        if isinstance(stmt, ast.CopyFrom):
+            from .ingest.copy_from import copy_from
+
+            return copy_from(self, stmt)
+        if isinstance(stmt, ast.Explain):
+            return self._execute_explain(stmt)
+        if isinstance(stmt, ast.SetVariable):
+            self.settings.set(stmt.name, stmt.value)
+            return None
+        if isinstance(stmt, ast.ShowVariable):
+            from .executor.runner import ResultSet
+
+            if stmt.name == "all":
+                items = sorted(self.settings.show_all().items())
+                return ResultSet(["name", "setting"],
+                                 {"name": [k for k, _ in items],
+                                  "setting": [str(v) for _, v in items]},
+                                 len(items))
+            v = self.settings.get(stmt.name)
+            return ResultSet(["setting"], {"setting": [str(v)]}, 1)
+        raise UnsupportedQueryError(
+            f"unsupported statement {type(stmt).__name__}")
+
+    # -- UDF surface -------------------------------------------------------
+    def _try_udf(self, sel: ast.Select):
+        if sel.from_items or len(sel.items) != 1:
+            return None
+        e = sel.items[0].expr
+        if not isinstance(e, ast.FuncCall) or e.name not in _UDFS:
+            return None
+        args = []
+        for a in e.args:
+            if not isinstance(a, ast.Literal):
+                raise PlanningError(f"{e.name}: arguments must be literals")
+            args.append(a.value)
+        from .executor.runner import ResultSet
+
+        if e.name == "create_distributed_table":
+            shard_count = int(args[2]) if len(args) > 2 else None
+            self.create_distributed_table(str(args[0]), str(args[1]),
+                                          shard_count)
+        elif e.name == "create_reference_table":
+            self.create_reference_table(str(args[0]))
+        elif e.name == "citus_add_node":
+            self.catalog.add_node(str(args[0]))
+            self._save_catalog()
+        elif e.name == "citus_remove_node":
+            self.catalog.remove_node(str(args[0]))
+            self._save_catalog()
+        elif e.name == "rebalance_table_shards":
+            from .operations.rebalancer import rebalance_table_shards
+
+            moves = rebalance_table_shards(self.catalog, self.store)
+            self._save_catalog()
+            return ResultSet(["moves"], {"moves": [len(moves)]}, 1)
+        elif e.name == "citus_move_shard_placement":
+            from .operations.shard_transfer import move_shard_placement
+
+            move_shard_placement(self.catalog, self.store, int(args[0]),
+                                 str(args[1]))
+            self._save_catalog()
+        elif e.name == "citus_get_node_clock":
+            from .transaction.clock import global_clock
+
+            return ResultSet(["clock"], {"clock": [global_clock.now()]}, 1)
+        return ResultSet(["ok"], {"ok": [True]}, 1)
+
+    # -- DDL ---------------------------------------------------------------
+    def _execute_create_table(self, stmt: ast.CreateTable):
+        if self.catalog.has_table(stmt.name):
+            if stmt.if_not_exists:
+                return None
+            raise CatalogError(f"table {stmt.name!r} already exists")
+        cols = tuple(ColumnDef(c.name, sql_type_to_datatype(c.type_name),
+                               nullable=not c.not_null)
+                     for c in stmt.columns)
+        self.catalog.create_local_table(stmt.name, TableSchema(cols))
+        self._save_catalog()
+        return None
+
+    def _execute_drop_table(self, stmt: ast.DropTable):
+        if not self.catalog.has_table(stmt.name):
+            if stmt.if_exists:
+                return None
+            raise CatalogError(f"table {stmt.name!r} does not exist")
+        self.catalog.drop_table(stmt.name)
+        self.store.drop_table_storage(stmt.name)
+        self._save_catalog()
+        return None
+
+    # -- DML ---------------------------------------------------------------
+    def _execute_insert_values(self, stmt: ast.InsertValues):
+        from .ingest.copy_from import insert_rows
+
+        meta = self.catalog.table(stmt.table)
+        columns = stmt.columns or tuple(meta.schema.names)
+        rows = []
+        for row in stmt.rows:
+            if len(row) != len(columns):
+                raise PlanningError("INSERT row arity mismatch")
+            values = []
+            for e in row:
+                if not isinstance(e, ast.Literal):
+                    raise PlanningError("INSERT values must be literals")
+                if e.type_hint == "date":
+                    from .types import date_to_days
+
+                    values.append(date_to_days(str(e.value)))
+                else:
+                    values.append(e.value)
+            rows.append(values)
+        return insert_rows(self, stmt.table, list(columns), rows)
+
+    def _execute_insert_select(self, stmt: ast.InsertSelect):
+        # pull-to-coordinator mode (the reference's third INSERT..SELECT
+        # mode); co-located pushdown is a planned optimization
+        from .ingest.copy_from import insert_rows
+
+        result = self._execute_select(stmt.query)
+        meta = self.catalog.table(stmt.table)
+        columns = list(stmt.columns or meta.schema.names)
+        rows = [list(r) for r in result.rows()]
+        return insert_rows(self, stmt.table, columns, rows)
+
+    # -- SELECT ------------------------------------------------------------
+    def _execute_select(self, sel: ast.Select):
+        plan, cleanup = self._plan_select(sel)
+        try:
+            return self.executor.execute_plan(plan)
+        finally:
+            for t in cleanup:
+                self._drop_temp(t)
+
+    def _plan_select(self, sel: ast.Select) -> tuple[QueryPlan, list[str]]:
+        cleanup: list[str] = []
+        sel = self._recursive_plan(sel, cleanup)
+        binder = Binder(self.catalog, _StoreDicts(self.store))
+        bound = binder.bind_select(sel)
+        planner = DistributedPlanner(
+            self.catalog, _StoreStats(self.store), self.n_devices,
+            self.settings.get("enable_repartition_joins"))
+        return planner.plan(bound), cleanup
+
+    def _execute_explain(self, stmt: ast.Explain):
+        from .executor.runner import ResultSet
+
+        if not isinstance(stmt.statement, ast.Select):
+            raise UnsupportedQueryError("EXPLAIN supports SELECT only")
+        plan, cleanup = self._plan_select(stmt.statement)
+        try:
+            lines = format_plan(plan, self.catalog)
+            if stmt.analyze:
+                import time
+
+                t0 = time.perf_counter()
+                result = self.executor.execute_plan(plan)
+                elapsed = time.perf_counter() - t0
+                lines.append(f"Execution Time: {elapsed * 1000:.2f} ms")
+                lines.append(f"Rows: {result.row_count}"
+                             + (f" (capacity retries: {result.retries})"
+                                if result.retries else ""))
+            return ResultSet(["QUERY PLAN"], {"QUERY PLAN": lines},
+                             len(lines))
+        finally:
+            for t in cleanup:
+                self._drop_temp(t)
+
+    # -- recursive planning ------------------------------------------------
+    def _recursive_plan(self, sel: ast.Select, cleanup: list[str],
+                        cte_scope: dict[str, str] | None = None) -> ast.Select:
+        cte_scope = dict(cte_scope or {})
+        for cte in sel.ctes:
+            inner = self._recursive_plan(cte.query, cleanup, cte_scope)
+            temp = self._materialize(inner, cleanup, cte.column_names)
+            cte_scope[cte.name] = temp
+        new_from = tuple(self._rewrite_from(fi, cleanup, cte_scope)
+                         for fi in sel.from_items)
+        rewrite = lambda e: self._rewrite_expr(e, cleanup, cte_scope)  # noqa: E731
+        return ast.Select(
+            items=tuple(ast.SelectItem(rewrite(i.expr), i.alias)
+                        for i in sel.items),
+            from_items=new_from,
+            where=rewrite(sel.where) if sel.where is not None else None,
+            group_by=tuple(rewrite(g) for g in sel.group_by),
+            having=rewrite(sel.having) if sel.having is not None else None,
+            order_by=tuple(ast.OrderItem(rewrite(o.expr), o.descending,
+                                         o.nulls_first)
+                           for o in sel.order_by),
+            limit=sel.limit, offset=sel.offset, distinct=sel.distinct,
+            ctes=())
+
+    def _rewrite_from(self, fi: ast.FromItem, cleanup, cte_scope):
+        if isinstance(fi, ast.TableRef):
+            if fi.name in cte_scope:
+                return ast.TableRef(cte_scope[fi.name],
+                                    fi.alias or fi.name)
+            return fi
+        if isinstance(fi, ast.SubqueryRef):
+            inner = self._recursive_plan(fi.query, cleanup, cte_scope)
+            temp = self._materialize(inner, cleanup)
+            return ast.TableRef(temp, fi.alias)
+        if isinstance(fi, ast.Join):
+            return ast.Join(fi.join_type,
+                            self._rewrite_from(fi.left, cleanup, cte_scope),
+                            self._rewrite_from(fi.right, cleanup, cte_scope),
+                            (self._rewrite_expr(fi.condition, cleanup,
+                                                cte_scope)
+                             if fi.condition is not None else None),
+                            fi.using_cols)
+        return fi
+
+    def _rewrite_expr(self, e: ast.Expr, cleanup, cte_scope) -> ast.Expr:
+        if isinstance(e, ast.ScalarSubquery):
+            inner = self._recursive_plan(e.query, cleanup, cte_scope)
+            result = self._execute_select(inner)
+            if result.row_count > 1:
+                raise ExecutionError(
+                    "scalar subquery returned more than one row")
+            if result.row_count == 0:
+                return ast.Literal(None)
+            return _value_to_literal(result.rows()[0][0])
+        if isinstance(e, ast.InSubquery):
+            inner = self._recursive_plan(e.query, cleanup, cte_scope)
+            result = self._execute_select(inner)
+            raw = [r[0] for r in result.rows()]
+            has_null = any(v is None for v in raw)
+            values = tuple(_value_to_literal(v) for v in raw
+                           if v is not None)
+            operand = self._rewrite_expr(e.operand, cleanup, cte_scope)
+            if e.negated:
+                # x NOT IN (..., NULL) is never TRUE (SQL three-valued)
+                if has_null:
+                    return ast.Literal(False)
+                if not values:
+                    return ast.Literal(True)  # NOT IN (empty) holds
+                return ast.InList(operand, values, True)
+            if not values:
+                return ast.Literal(False)
+            # positive IN: dropping NULLs is exact under WHERE semantics
+            # (x IN (..., NULL) is TRUE or NULL, never FALSE-turned-TRUE)
+            return ast.InList(operand, values, False)
+        if isinstance(e, ast.Exists):
+            inner = self._recursive_plan(e.query, cleanup, cte_scope)
+            limited = dc_replace(inner, limit=1)
+            result = self._execute_select(limited)
+            found = result.row_count > 0
+            return ast.Literal(found != e.negated)
+        # structural recursion
+        if isinstance(e, ast.BinaryOp):
+            return ast.BinaryOp(e.op,
+                                self._rewrite_expr(e.left, cleanup, cte_scope),
+                                self._rewrite_expr(e.right, cleanup,
+                                                   cte_scope))
+        if isinstance(e, ast.UnaryOp):
+            return ast.UnaryOp(e.op, self._rewrite_expr(e.operand, cleanup,
+                                                        cte_scope))
+        if isinstance(e, ast.Between):
+            return ast.Between(
+                self._rewrite_expr(e.operand, cleanup, cte_scope),
+                self._rewrite_expr(e.low, cleanup, cte_scope),
+                self._rewrite_expr(e.high, cleanup, cte_scope), e.negated)
+        if isinstance(e, ast.InList):
+            return ast.InList(
+                self._rewrite_expr(e.operand, cleanup, cte_scope),
+                tuple(self._rewrite_expr(x, cleanup, cte_scope)
+                      for x in e.items), e.negated)
+        if isinstance(e, ast.CaseWhen):
+            return ast.CaseWhen(
+                tuple((self._rewrite_expr(c, cleanup, cte_scope),
+                       self._rewrite_expr(r, cleanup, cte_scope))
+                      for c, r in e.whens),
+                (self._rewrite_expr(e.else_result, cleanup, cte_scope)
+                 if e.else_result is not None else None))
+        if isinstance(e, ast.FuncCall):
+            return ast.FuncCall(e.name,
+                                tuple(self._rewrite_expr(a, cleanup,
+                                                         cte_scope)
+                                      for a in e.args),
+                                e.distinct, e.star)
+        return e
+
+    def _materialize(self, sel: ast.Select, cleanup: list[str],
+                     column_names: tuple[str, ...] = ()) -> str:
+        """Execute a subquery and store its rows as a temp reference table
+        (the intermediate-result broadcast analogue)."""
+        result = self._execute_select(sel)
+        self._temp_counter += 1
+        name = f"__intermediate_{self._temp_counter}"
+        names = (list(column_names) if column_names
+                 else result.column_names)
+        cols = []
+        arrays = {}
+        dicts = {}
+        for out_name, col_name in zip(result.column_names, names):
+            data = result.columns[out_name]
+            dtype, arr, dvals = _infer_column(data, result.row_count)
+            cols.append(ColumnDef(col_name, dtype))
+            arrays[col_name] = arr
+            if dvals is not None:
+                dicts[col_name] = dvals
+        self.catalog.create_reference_table(name, TableSchema(tuple(cols)))
+        cleanup.append(name)
+        if result.row_count > 0:
+            # validity from the pre-intern object arrays (None = NULL)
+            validity = {c: (~_none_mask(a) if a.dtype == object
+                            else np.ones(result.row_count, dtype=bool))
+                        for c, a in arrays.items()}
+            for col_name, values in dicts.items():
+                d = self.store.dictionary(name, col_name)
+                arrays[col_name] = d.intern_array(values)
+            arrays = {c: _object_to_typed(a) for c, a in arrays.items()}
+            shard = self.catalog.table_shards(name)[0]
+            self.store.append_stripe(name, shard.shard_id, arrays,
+                                     validity)
+        return name
+
+    def _drop_temp(self, name: str):
+        try:
+            self.catalog.drop_table(name)
+        except CatalogError:
+            pass
+        self.store.drop_table_storage(name)
+
+    def _save_catalog(self):
+        self.catalog.save(os.path.join(self.data_dir, "catalog.json"))
+
+
+def _value_to_literal(v) -> ast.Literal:
+    if v is None:
+        return ast.Literal(None)
+    if isinstance(v, (np.integer,)):
+        return ast.Literal(int(v))
+    if isinstance(v, (np.floating,)):
+        return ast.Literal(float(v))
+    if isinstance(v, (np.bool_, bool)):
+        return ast.Literal(bool(v))
+    if isinstance(v, str):
+        return ast.Literal(v)
+    if isinstance(v, (int, float)):
+        return ast.Literal(v)
+    raise ExecutionError(f"cannot inline value of type {type(v).__name__}")
+
+
+def _infer_column(data, n: int):
+    """Result column → (DataType, array, dict_values | None)."""
+    arr = np.asarray(data)
+    if arr.dtype == object:
+        non_null = [x for x in data if x is not None]
+        if non_null and isinstance(non_null[0], str):
+            return DataType.STRING, np.asarray(data, dtype=object), list(data)
+        typed = np.array([0 if x is None else x for x in data])
+        dt = _np_to_datatype(typed.dtype)
+        return dt, np.asarray(data, dtype=object), None
+    return _np_to_datatype(arr.dtype), arr, None
+
+
+def _np_to_datatype(dt) -> DataType:
+    if dt == np.int32:
+        return DataType.INT32
+    if np.issubdtype(dt, np.integer):
+        return DataType.INT64
+    if dt == np.float32:
+        return DataType.FLOAT32
+    if np.issubdtype(dt, np.floating):
+        return DataType.FLOAT64
+    if dt == np.bool_:
+        return DataType.BOOL
+    return DataType.FLOAT64
+
+
+def _none_mask(arr) -> np.ndarray:
+    return np.array([x is None for x in arr], dtype=bool)
+
+
+def _object_to_typed(arr: np.ndarray) -> np.ndarray:
+    if arr.dtype != object:
+        return arr
+    return np.array([0 if x is None else x for x in arr])

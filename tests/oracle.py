@@ -1,0 +1,109 @@
+"""sqlite3 oracle: run the same SQL on the same rows and compare.
+
+The framework's version of the reference's randomized cross-check strategy
+(citus_tests/query_generator compares distributed results against vanilla
+PostgreSQL — SURVEY §4).  Dates are stored as ISO strings in sqlite so date
+comparisons behave; the framework's DATE outputs are also ISO strings.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+import sqlite3
+
+from citus_tpu.types import days_to_date
+
+
+def make_oracle(tables: dict[str, dict], date_columns: dict[str, list[str]]):
+    conn = sqlite3.connect(":memory:")
+    for name, cols in tables.items():
+        colnames = list(cols.keys())
+        conn.execute(f"create table {name} ({', '.join(colnames)})")
+        n = len(next(iter(cols.values())))
+        rows = []
+        for i in range(n):
+            row = []
+            for c in colnames:
+                v = cols[c][i]
+                if c in date_columns.get(name, []):
+                    v = days_to_date(int(v))
+                elif hasattr(v, "item"):
+                    v = v.item()
+                row.append(v)
+            rows.append(tuple(row))
+        ph = ",".join("?" * len(colnames))
+        conn.executemany(f"insert into {name} values ({ph})", rows)
+    return conn
+
+
+def run_oracle(conn: sqlite3.Connection, sql: str) -> list[tuple]:
+    # sqlite doesn't know date/interval literals: rewrite to strings.
+    sql = re.sub(r"date\s+'(\d{4}-\d{2}-\d{2})'", r"'\1'", sql,
+                 flags=re.IGNORECASE)
+    sql = _fold_intervals(sql)
+    sql = re.sub(r"extract\s*\(\s*year\s+from\s+(\w+)\s*\)",
+                 r"cast(strftime('%Y', \1) as integer)", sql,
+                 flags=re.IGNORECASE)
+    return conn.execute(sql).fetchall()
+
+
+def _fold_intervals(sql: str) -> str:
+    """'1994-01-01' + interval '1' year → '1995-01-01' (const folding)."""
+    import datetime
+
+    pat = re.compile(
+        r"'(\d{4})-(\d{2})-(\d{2})'\s*([+-])\s*interval\s+'(\d+)'\s+"
+        r"(day|month|year)s?", re.IGNORECASE)
+
+    def fold(m):
+        y, mo, d = int(m.group(1)), int(m.group(2)), int(m.group(3))
+        sign = 1 if m.group(4) == "+" else -1
+        qty = sign * int(m.group(5))
+        unit = m.group(6).lower()
+        date = datetime.date(y, mo, d)
+        if unit == "day":
+            date += datetime.timedelta(days=qty)
+        elif unit == "month":
+            total = date.year * 12 + date.month - 1 + qty
+            yy, mm = divmod(total, 12)
+            date = datetime.date(yy, mm + 1, min(date.day, 28))
+        else:
+            date = datetime.date(date.year + qty, date.month, date.day)
+        return f"'{date.isoformat()}'"
+
+    return pat.sub(fold, sql)
+
+
+def compare_results(got_rows: list[tuple], want_rows: list[tuple],
+                    ordered: bool, float_tol: float = 1e-6) -> None:
+    assert len(got_rows) == len(want_rows), \
+        f"row count: got {len(got_rows)}, oracle {len(want_rows)}"
+    if not ordered:
+        got_rows = sorted(got_rows, key=_row_key)
+        want_rows = sorted(want_rows, key=_row_key)
+    for i, (g, w) in enumerate(zip(got_rows, want_rows)):
+        assert len(g) == len(w), f"row {i}: arity {len(g)} vs {len(w)}"
+        for j, (a, b) in enumerate(zip(g, w)):
+            _compare_cell(a, b, f"row {i} col {j}", float_tol)
+
+
+def _compare_cell(a, b, where: str, tol: float) -> None:
+    if a is None or b is None:
+        assert a is None and b is None, f"{where}: {a!r} vs {b!r}"
+        return
+    if hasattr(a, "item"):
+        a = a.item()
+    if isinstance(a, float) or isinstance(b, float):
+        fa, fb = float(a), float(b)
+        if math.isnan(fa) and math.isnan(fb):
+            return
+        denom = max(abs(fa), abs(fb), 1.0)
+        assert abs(fa - fb) / denom <= tol, f"{where}: {fa} vs {fb}"
+        return
+    assert a == b, f"{where}: {a!r} vs {b!r}"
+
+
+def _row_key(row):
+    return tuple((x is None, str(type(x)), x if x is not None else 0)
+                 for x in row)
