@@ -1,0 +1,64 @@
+"""Benchmark driver: TPC-H Q1 scan-aggregate throughput on one chip.
+
+Prints exactly ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Baseline: the reference's only published scan-aggregate number — the
+columnar engine aggregating 75M rows in 16 s (≈4.69M rows/s) on a 2-vCPU
+Azure VM (/root/reference/src/backend/columnar/README.md:303-321, the "27×
+vs row tables" measurement).  Q1 is the same shape of work (scan + filter +
+grouped aggregation over lineitem) so rows/sec is directly comparable.
+
+Env knobs: BENCH_SF (scale factor, default 0.2), BENCH_REPEATS (default 3),
+BENCH_QUERY (default Q1).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+BASELINE_ROWS_PER_SEC = 75_000_000 / 16.0  # reference columnar agg scan
+
+
+def main() -> None:
+    sf = float(os.environ.get("BENCH_SF", "0.2"))
+    repeats = int(os.environ.get("BENCH_REPEATS", "3"))
+    qname = os.environ.get("BENCH_QUERY", "Q1")
+
+    from citus_tpu.session import Session
+    from citus_tpu.ingest.tpch import QUERIES, load_into_session
+
+    data_dir = tempfile.mkdtemp(prefix="citus_tpu_bench_")
+    try:
+        sess = Session(data_dir=data_dir)
+        counts = load_into_session(sess, sf=sf, seed=0)
+        lineitem_rows = sess.store.table_row_count("lineitem")
+        sql = QUERIES[qname]
+
+        # warmup: compile + populate host caches
+        sess.execute(sql)
+        best = float("inf")
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            result = sess.execute(sql)
+            dt = time.perf_counter() - t0
+            best = min(best, dt)
+        assert result.row_count > 0
+        rows_per_sec = lineitem_rows / best
+        print(json.dumps({
+            "metric": f"tpch_{qname.lower()}_rows_per_sec",
+            "value": round(rows_per_sec, 1),
+            "unit": "rows/s",
+            "vs_baseline": round(rows_per_sec / BASELINE_ROWS_PER_SEC, 3),
+        }))
+    finally:
+        shutil.rmtree(data_dir, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()
