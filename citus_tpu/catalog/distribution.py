@@ -62,8 +62,13 @@ def hash_token(values: np.ndarray) -> np.ndarray:
         v = values.view(np.uint64) if values.dtype == np.uint64 else values.astype(np.uint64)
         lo = (v & np.uint64(0xFFFFFFFF)).astype(np.uint32)
         hi = (v >> np.uint64(32)).astype(np.uint32)
-        mixed = fmix32(lo) ^ fmix32(hi ^ np.uint32(0x9E3779B9))
-        return mixed.view(np.int32)
+        # PG hashint8-style width fold: for values that fit in int32 the
+        # folded word equals the int32 word, so int64 and int32 columns
+        # hash identically for equal values — required for repartition
+        # routing when join-key widths differ (executor casts keys to i64)
+        nonneg = hi < np.uint32(0x80000000)
+        folded = lo ^ np.where(nonneg, hi, ~hi)
+        return fmix32(folded).view(np.int32)
     if values.dtype == np.float64:
         return hash_token(values.view(np.int64))
     if values.dtype == np.float32:

@@ -23,7 +23,6 @@ from __future__ import annotations
 
 import math
 from dataclasses import dataclass
-from functools import partial
 
 import jax
 import jax.numpy as jnp
@@ -32,10 +31,9 @@ from jax.sharding import Mesh, PartitionSpec as P
 from jax import shard_map
 
 from ..catalog.distribution import HASH_TOKEN_COUNT, INT32_MIN
-from ..errors import CapacityOverflowError, ExecutionError, PlanningError
+from ..errors import ExecutionError, PlanningError
 from ..ops import expand_join, pack_by_target, segment_aggregate
 from ..ops.hashing import hash_token_jax
-from ..planner import expr as ir
 from ..planner.plan import (
     AggregateNode,
     JoinNode,
@@ -483,11 +481,6 @@ class PlanCompiler:
         for (a, cid), r in zip(node.aggs, res):
             cols[cid] = r
         return Block(cols, gvalid, nulls)
-
-
-def _iter_key_cids(key_meta):
-    for cid, has_null in key_meta:
-        yield None, cid
 
 
 def _src(blk: Block) -> ColumnSource:

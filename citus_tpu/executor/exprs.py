@@ -200,13 +200,19 @@ def _safe_div(lv, rv, xp):
     if np.issubdtype(np.asarray(rv).dtype if xp is np else rv.dtype,
                      np.integer):
         rv_safe = xp.where(rv == 0, xp.ones((), dtype=rv.dtype), rv)
-        return lv // rv_safe
+        # SQL integer division truncates toward zero; // floors — bump the
+        # quotient when signs differ and the division is inexact
+        q = lv // rv_safe
+        r = lv - q * rv_safe
+        return q + ((r != 0) & ((lv < 0) != (rv_safe < 0))).astype(q.dtype)
     return lv / xp.where(rv == 0, xp.asarray(np.nan, dtype=rv.dtype), rv)
 
 
 def _safe_mod(lv, rv, xp):
+    # fmod semantics (sign of the dividend) — SQL/PG modulo truncates,
+    # Python/numpy % floors; (-7) % 2 must be -1, not 1
     rv_safe = xp.where(rv == 0, xp.ones((), dtype=rv.dtype), rv)
-    return lv % rv_safe
+    return xp.fmod(lv, rv_safe)
 
 
 # Gregorian civil-date decomposition from days-since-epoch, branch-free

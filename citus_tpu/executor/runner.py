@@ -40,6 +40,10 @@ class ResultSet:
     column_names: list[str]
     columns: dict[str, np.ndarray | list]
     row_count: int
+    # output SQL types by column name (None where a producer has no type
+    # info, e.g. UDF results); lets consumers round-trip DATE values that
+    # the combine phase formatted to ISO strings
+    dtypes: dict[str, DataType] | None = None
     # execution metadata (EXPLAIN ANALYZE / stats counters read these)
     retries: int = 0
     device_rows_scanned: int = 0
@@ -158,6 +162,7 @@ class Executor:
         # select outputs
         out_cols: dict[str, object] = {}
         out_nulls: dict[str, np.ndarray] = {}
+        out_dtypes: dict[str, DataType] = {}
         names: list[str] = []
         for e, name in plan.host_select:
             v, nmask = evaluate(e, src, np)
@@ -168,6 +173,7 @@ class Executor:
             names.append(out_name)
             out_cols[out_name] = v
             out_nulls[out_name] = nmask
+            out_dtypes[out_name] = e.dtype
             # decode dictionary strings / format dates
             if isinstance(e, ir.BCol) and e.cid in plan.decode:
                 table, column = plan.decode[e.cid]
@@ -227,7 +233,7 @@ class Executor:
                 out_cols[c] = np.array(
                     [None if nm else v for v, nm in zip(col, out_nulls[c])],
                     dtype=object)
-        return ResultSet(names, out_cols, final_n)
+        return ResultSet(names, out_cols, final_n, dtypes=out_dtypes)
 
     @staticmethod
     def _unique_name(name: str, taken: list[str]) -> str:

@@ -84,8 +84,12 @@ def plan_rebalance(catalog: Catalog, store: TableStore,
             cost = group_cost[key]
             new_hi = (node_util[hi] - cost) / capacity[hi]
             new_lo = (node_util[lo] + cost) / capacity[lo]
-            # the move must actually shrink the peak (improvement gate)
-            if max(new_hi, new_lo) < util[hi]:
+            # improvement gate (pg_dist_rebalance_strategy
+            # improvement_threshold semantics): the move must shrink the
+            # peak, and by at least `improvement_threshold` of the peak's
+            # distance to the mean — small shuffles aren't worth a move
+            gain = util[hi] - max(new_hi, new_lo)
+            if gain > 0 and gain >= improvement_threshold * (util[hi] - avg):
                 anchor = min(groups[key])
                 moves.append(PlacementUpdate(anchor, hi, lo, cost))
                 node_util[hi] -= cost

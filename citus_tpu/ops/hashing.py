@@ -44,8 +44,12 @@ def hash_token_jax(values: jnp.ndarray) -> jnp.ndarray:
         v = values.astype(jnp.uint64)
         lo = (v & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32)
         hi = (v >> jnp.uint64(32)).astype(jnp.uint32)
-        mixed = fmix32_jax(lo) ^ fmix32_jax(hi ^ jnp.uint32(0x9E3779B9))
-        return mixed.astype(jnp.int32)
+        # PG hashint8-style width fold (see distribution.hash_token): makes
+        # int64 hashing agree with int32 for in-range values, so executor
+        # key casts to int64 keep host/device routing parity
+        nonneg = hi < jnp.uint32(0x80000000)
+        folded = lo ^ jnp.where(nonneg, hi, ~hi)
+        return fmix32_jax(folded).astype(jnp.int32)
     if dt == jnp.float64:
         # bit pattern, not value: int64 view
         return hash_token_jax(

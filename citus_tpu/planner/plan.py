@@ -177,12 +177,13 @@ class DistributedPlanner:
 
         decode: dict[str, tuple[str, str]] = {}
         if q.is_aggregate or q.distinct:
-            root, host_select, having = self._plan_aggregate(q, joined, decode)
+            root, host_select, having, host_order = self._plan_aggregate(
+                q, joined, decode)
         else:
-            root, host_select = self._plan_projection(q, joined, decode)
+            root, host_select, host_order = self._plan_projection(
+                q, joined, decode)
             having = None
 
-        host_order = self._rewrite_order_by(q, host_select)
         return QueryPlan(root=root, n_devices=self.n_devices,
                          host_select=host_select, host_having=having,
                          host_order_by=host_order, limit=q.limit,
@@ -444,8 +445,19 @@ class DistributedPlanner:
             node.dist = Dist(right.dist.kind, extend_cids(right.dist.cids),
                              right.dist.shard_count, right.dist.placement)
         elif strategy == "repart_both":
-            node.dist = self.device_dist(
-                frozenset().union(*edge_lcids, *edge_rcids))
+            if len(edge_lcids) == 1 and \
+                    isinstance(left_keys[0], ir.BCol) and \
+                    isinstance(right_keys[0], ir.BCol):
+                # a single BARE-COLUMN key shuffles by hash_token over
+                # identity placement — genuinely reusable as a partition
+                # property; expression keys route by the expression's hash,
+                # which is NOT a partitioning of the underlying columns
+                node.dist = self.device_dist(edge_lcids[0] | edge_rcids[0])
+            else:
+                # multi-key shuffles route by the COMPOSITE hash; claiming
+                # per-column partitioning would let a later join/aggregate
+                # falsely align with single-column hash placement
+                node.dist = self.device_dist(frozenset())
         elif strategy == "cartesian":
             raise PlanningError(
                 "cartesian products are not supported (add a join clause)")
@@ -504,6 +516,21 @@ class DistributedPlanner:
 
         host_select = [(rewrite(e), name) for e, name in q.select]
         having = rewrite(q.having) if q.having is not None else None
+        host_order = []
+        group_cids = {cid for _, cid in group_keys}
+        for e, desc, nf in q.order_by:
+            re_ = rewrite(e)  # may register new aggregates (ORDER BY sum(x))
+            for n in ir.walk(re_):
+                # after rewrite, only group ("gN") / aggregate ("aggN")
+                # references are legal; a raw relation cid ("2.col") means
+                # the sort column is neither grouped nor aggregated
+                if isinstance(n, ir.BCol) and n.cid not in group_cids \
+                        and not n.cid.startswith("agg"):
+                    raise PlanningError(
+                        f"ORDER BY column {n.cid.split('.')[-1]!r} must "
+                        "appear in the GROUP BY clause or be used in an "
+                        "aggregate function")
+            host_order.append((re_, desc, nf))
 
         node = AggregateNode(
             combine="", input=input_node,
@@ -527,34 +554,40 @@ class DistributedPlanner:
             node.out_columns[cid] = g.dtype
         for a, cid in aggs:
             node.out_columns[cid] = a.dtype
-        return node, host_select, having
+        return node, host_select, having, host_order
 
     def _plan_projection(self, q: BoundQuery, input_node: PlanNode,
                          decode: dict):
         exprs = []
         host_select = []
-        for i, (e, name) in enumerate(q.select):
-            cid = f"p{i}"
+        col_by_expr: dict[ir.BExpr, ir.BCol] = {}
+
+        def add_output(e: ir.BExpr, cid: str) -> ir.BCol:
             exprs.append((e, cid))
-            host_select.append((ir.BCol(cid, e.dtype), name))
+            col = ir.BCol(cid, e.dtype)
+            col_by_expr[e] = col
             if isinstance(e, ir.BCol) and e.dtype == DataType.STRING:
                 decode[cid] = (e.table, e.column)
+            return col
+
+        for i, (e, name) in enumerate(q.select):
+            host_select.append((add_output(e, f"p{i}"), name))
+        # ORDER BY columns not in the select list become hidden device
+        # outputs (the sort happens host-side over device results)
+        host_order = []
+        for e, desc, nf in q.order_by:
+            if any(isinstance(n, ir.BAgg) for n in ir.walk(e)):
+                raise PlanningError(
+                    "aggregates in ORDER BY require a GROUP BY query")
+            col = col_by_expr.get(e)
+            if col is None:
+                col = add_output(e, f"s{len(exprs)}")
+            host_order.append((col, desc, nf))
         node = ProjectNode(input=input_node, exprs=exprs)
         node.dist = input_node.dist
         node.est_rows = input_node.est_rows
         node.out_columns = {cid: e.dtype for e, cid in exprs}
-        return node, host_select
-
-    def _rewrite_order_by(self, q: BoundQuery, host_select):
-        # order-by exprs were bound against the same objects as select;
-        # rewrite them in terms of host_select outputs where they match
-        name_by_expr = {}
-        for (orig, name), (rewritten, _) in zip(q.select, host_select):
-            name_by_expr[orig] = rewritten
-        out = []
-        for e, desc, nf in q.order_by:
-            out.append((name_by_expr.get(e, e), desc, nf))
-        return out
+        return node, host_select, host_order
 
 
 _STRATEGY_RANK = {"broadcast": 0, "broadcast_left": 0, "local": 1,

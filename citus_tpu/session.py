@@ -360,13 +360,15 @@ class Session:
                     "scalar subquery returned more than one row")
             if result.row_count == 0:
                 return ast.Literal(None)
-            return _value_to_literal(result.rows()[0][0])
+            dt = _result_dtype(result, 0)
+            return _value_to_literal(result.rows()[0][0], dt)
         if isinstance(e, ast.InSubquery):
             inner = self._recursive_plan(e.query, cleanup, cte_scope)
             result = self._execute_select(inner)
+            dt = _result_dtype(result, 0)
             raw = [r[0] for r in result.rows()]
             has_null = any(v is None for v in raw)
-            values = tuple(_value_to_literal(v) for v in raw
+            values = tuple(_value_to_literal(v, dt) for v in raw
                            if v is not None)
             operand = self._rewrite_expr(e.operand, cleanup, cte_scope)
             if e.negated:
@@ -435,7 +437,17 @@ class Session:
         dicts = {}
         for out_name, col_name in zip(result.column_names, names):
             data = result.columns[out_name]
-            dtype, arr, dvals = _infer_column(data, result.row_count)
+            rdt = _result_dtype(result, out_name)
+            if rdt == DataType.DATE:
+                # keep DATE columns as day numbers in the temp table (the
+                # combine phase formatted them to ISO text)
+                from .types import date_to_days
+
+                arr = np.array([None if x is None else date_to_days(str(x))
+                                for x in data], dtype=object)
+                dtype, dvals = DataType.DATE, None
+            else:
+                dtype, arr, dvals = _infer_column(data, result.row_count)
             cols.append(ColumnDef(col_name, dtype))
             arrays[col_name] = arr
             if dvals is not None:
@@ -467,9 +479,24 @@ class Session:
         self.catalog.save(os.path.join(self.data_dir, "catalog.json"))
 
 
-def _value_to_literal(v) -> ast.Literal:
+def _result_dtype(result, col: int | str):
+    if result.dtypes is None:
+        return None
+    if isinstance(col, int):
+        col = result.column_names[col]
+    return result.dtypes.get(col)
+
+
+def _value_to_literal(v, dtype=None) -> ast.Literal:
     if v is None:
         return ast.Literal(None)
+    if dtype == DataType.DATE:
+        # the combine phase formatted DATE to ISO text; fold back to the
+        # storage representation (days since epoch) so comparisons against
+        # DATE columns bind as integers
+        from .types import date_to_days
+
+        return ast.Literal(date_to_days(str(v)))
     if isinstance(v, (np.integer,)):
         return ast.Literal(int(v))
     if isinstance(v, (np.floating,)):
