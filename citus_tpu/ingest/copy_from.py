@@ -36,7 +36,7 @@ def copy_from(session, stmt: ast.CopyFrom):
     for batch in iter_text_batches(stmt.path, delimiter, stmt.header,
                                    stmt.null_string, len(columns),
                                    batch_rows):
-        total += _ingest_batch(session, stmt.table, columns, batch)
+        total += _ingest_batch(session, stmt.table, columns, batch)[0]
     return ResultSet(["copied"], {"copied": [total]}, 1)
 
 
@@ -44,6 +44,17 @@ def insert_rows(session, table: str, columns: list[str],
                 rows: list[list]) -> object:
     from ..executor.runner import ResultSet
 
+    n, _pending = prepare_rows(session, table, columns, rows, commit=True)
+    return ResultSet(["inserted"], {"inserted": [n]}, 1)
+
+
+def prepare_rows(session, table: str, columns: list[str], rows: list[list],
+                 commit: bool = True) -> tuple[int, list]:
+    """Type-convert + route + write per-shard stripes.  With commit=False
+    the stripes stay invisible and the (shard_id, record) list is returned
+    for the caller to fold into one atomic apply_dml/commit_pending (MERGE
+    uses this so its inserts land in the same manifest flip as its
+    updates/deletes)."""
     meta = session.catalog.table(table)
     if set(columns) != set(meta.schema.names):
         missing = [c for c in meta.schema.names if c not in columns]
@@ -64,15 +75,17 @@ def insert_rows(session, table: str, columns: list[str],
             else:
                 vals.append(v)
         text_cells[c] = vals
-    n = _ingest_batch(session, table, meta.schema.names,
-                      [text_cells[c] for c in meta.schema.names],
-                      pre_typed=True)
-    return ResultSet(["inserted"], {"inserted": [n]}, 1)
+    return _ingest_batch(session, table, meta.schema.names,
+                         [text_cells[c] for c in meta.schema.names],
+                         pre_typed=True, commit=commit)
 
 
 def _ingest_batch(session, table: str, columns: list[str],
-                  batch: list[list], pre_typed: bool = False) -> int:
-    """batch: per-column list of python values (str|None from COPY)."""
+                  batch: list[list], pre_typed: bool = False,
+                  commit: bool = True) -> tuple[int, list]:
+    """batch: per-column list of python values (str|None from COPY).
+    Returns (row_count, pending); pending is non-empty only when
+    commit=False."""
     meta = session.catalog.table(table)
     n = len(batch[0])
     if n == 0:
@@ -115,13 +128,21 @@ def _ingest_batch(session, table: str, columns: list[str],
                 table, s.shard_id, sub, subv, codec=codec, level=level,
                 chunk_rows=chunk_rows, commit=False)
             pending.append((s.shard_id, rec))
-        session.store.commit_pending(table, pending)
+        if commit:
+            session.store.commit_pending(table, pending)
+            pending = []
     else:
         shard = session.catalog.table_shards(table)[0]
-        session.store.append_stripe(table, shard.shard_id, typed, validity,
-                                    codec=codec, level=level,
-                                    chunk_rows=chunk_rows)
-    return n
+        rec = session.store.append_stripe(
+            table, shard.shard_id, typed, validity, codec=codec,
+            level=level, chunk_rows=chunk_rows, commit=commit)
+        pending = [] if commit else [(shard.shard_id, rec)]
+    stats = getattr(session, "stats", None)
+    if stats is not None:
+        from ..stats.counters import ROWS_INGESTED
+
+        stats.counters.increment(ROWS_INGESTED, n)
+    return n, pending
 
 
 def _routing_tokens(session, table, column, dtype, values: np.ndarray):

@@ -277,7 +277,7 @@ def load_into_session(session, sf: float = 0.001, seed: int = 0,
                  for c in names]
         counts[table] = _ingest_batch(session, table, names,
                                       [list(b) for b in batch],
-                                      pre_typed=True)
+                                      pre_typed=True)[0]
     return counts
 
 

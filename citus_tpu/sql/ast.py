@@ -399,6 +399,48 @@ class InsertSelect(Statement):
 
 
 @dataclass(frozen=True)
+class Assignment(Node):
+    column: str
+    value: Expr
+
+
+@dataclass(frozen=True)
+class Update(Statement):
+    table: str
+    alias: Optional[str]
+    assignments: tuple[Assignment, ...]
+    where: Optional[Expr] = None
+
+
+@dataclass(frozen=True)
+class Delete(Statement):
+    table: str
+    alias: Optional[str] = None
+    where: Optional[Expr] = None
+
+
+@dataclass(frozen=True)
+class MergeAction(Node):
+    """One WHEN [NOT] MATCHED [AND cond] THEN <action> clause."""
+
+    kind: str                                  # update | delete | insert | nothing
+    condition: Optional[Expr] = None
+    assignments: tuple[Assignment, ...] = ()   # kind == update
+    insert_columns: tuple[str, ...] = ()       # kind == insert; empty = all
+    insert_values: tuple[Expr, ...] = ()
+
+
+@dataclass(frozen=True)
+class Merge(Statement):
+    target: str
+    target_alias: Optional[str]
+    source: FromItem          # TableRef or SubqueryRef
+    on: Expr
+    matched: tuple[MergeAction, ...] = ()
+    not_matched: tuple[MergeAction, ...] = ()
+
+
+@dataclass(frozen=True)
 class CopyFrom(Statement):
     table: str
     path: str

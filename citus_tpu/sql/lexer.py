@@ -17,6 +17,7 @@ like between distinct case when then else end join inner left right full outer
 cross on create table drop insert into values copy with delimiter header format
 csv text exists interval date cast extract substring for if asc desc nulls
 first last set show explain analyze verbose union all true false using
+update delete merge matched do nothing returning
 """.split())
 
 # multi-char operators first (longest match)

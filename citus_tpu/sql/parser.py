@@ -104,6 +104,12 @@ class Parser:
             return self.parse_drop_table()
         if self.at_keyword("insert"):
             return self.parse_insert()
+        if self.at_keyword("update"):
+            return self.parse_update()
+        if self.at_keyword("delete"):
+            return self.parse_delete()
+        if self.at_keyword("merge"):
+            return self.parse_merge()
         if self.at_keyword("copy"):
             return self.parse_copy()
         if self.at_keyword("explain"):
@@ -588,6 +594,88 @@ class Parser:
             if not self.accept_op(","):
                 break
         return ast.InsertValues(table, columns, tuple(rows))
+
+    def _parse_table_alias(self) -> str | None:
+        if self.accept_keyword("as"):
+            return self.expect_ident()
+        if self.cur.kind == "ident":
+            return self.advance().value
+        return None
+
+    def _parse_assignments(self) -> tuple[ast.Assignment, ...]:
+        self.expect_keyword("set")
+        assigns = []
+        while True:
+            col = self.expect_ident()
+            self.expect_op("=")
+            assigns.append(ast.Assignment(col, self.parse_expr()))
+            if not self.accept_op(","):
+                return tuple(assigns)
+
+    def parse_update(self) -> ast.Update:
+        self.expect_keyword("update")
+        table = self.expect_ident()
+        alias = self._parse_table_alias()
+        assigns = self._parse_assignments()
+        where = self.parse_expr() if self.accept_keyword("where") else None
+        return ast.Update(table, alias, assigns, where)
+
+    def parse_delete(self) -> ast.Delete:
+        self.expect_keyword("delete")
+        self.expect_keyword("from")
+        table = self.expect_ident()
+        alias = self._parse_table_alias()
+        where = self.parse_expr() if self.accept_keyword("where") else None
+        return ast.Delete(table, alias, where)
+
+    def parse_merge(self) -> ast.Merge:
+        self.expect_keyword("merge")
+        self.expect_keyword("into")
+        target = self.expect_ident()
+        target_alias = self._parse_table_alias()
+        self.expect_keyword("using")
+        source = self.parse_table_primary()
+        self.expect_keyword("on")
+        on = self.parse_expr()
+        matched: list[ast.MergeAction] = []
+        not_matched: list[ast.MergeAction] = []
+        while self.accept_keyword("when"):
+            negated = self.accept_keyword("not")
+            self.expect_keyword("matched")
+            cond = self.parse_expr() if self.accept_keyword("and") else None
+            self.expect_keyword("then")
+            if self.accept_keyword("do"):
+                self.expect_keyword("nothing")
+                action = ast.MergeAction("nothing", cond)
+            elif negated:
+                self.expect_keyword("insert")
+                cols: tuple[str, ...] = ()
+                if self.accept_op("("):
+                    names = [self.expect_ident()]
+                    while self.accept_op(","):
+                        names.append(self.expect_ident())
+                    self.expect_op(")")
+                    cols = tuple(names)
+                self.expect_keyword("values")
+                self.expect_op("(")
+                vals = [self.parse_expr()]
+                while self.accept_op(","):
+                    vals.append(self.parse_expr())
+                self.expect_op(")")
+                action = ast.MergeAction("insert", cond,
+                                         insert_columns=cols,
+                                         insert_values=tuple(vals))
+            elif self.accept_keyword("delete"):
+                action = ast.MergeAction("delete", cond)
+            else:
+                self.expect_keyword("update")
+                action = ast.MergeAction("update", cond,
+                                         assignments=self._parse_assignments())
+            (not_matched if negated else matched).append(action)
+        if not matched and not not_matched:
+            self.error("MERGE needs at least one WHEN clause")
+        return ast.Merge(target, target_alias, source, on,
+                         tuple(matched), tuple(not_matched))
 
     def parse_copy(self) -> ast.CopyFrom:
         self.expect_keyword("copy")

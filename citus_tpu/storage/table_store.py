@@ -142,6 +142,95 @@ class TableStore:
                 man["next_stripe"] = max(man["next_stripe"], stripe_no + 1)
             self._save_manifest(table)
 
+    # -- DML (deletion bitmaps) -------------------------------------------
+    # The reference's columnar engine is append-only (columnar/README.md:
+    # 40-62: no UPDATE/DELETE); distributed DML there routes to row-store
+    # shards (multi_router_planner.c CreateModifyPlan).  Here every table is
+    # columnar, so DML uses per-stripe deletion bitmaps: DELETE marks rows,
+    # UPDATE = delete + append, both made visible by ONE manifest write.
+
+    def _delete_mask_path(self, table: str, shard_id: int, fname: str) -> str:
+        return os.path.join(self.shard_dir(table, shard_id), fname)
+
+    def load_delete_mask(self, table: str, shard_id: int,
+                         record: dict) -> np.ndarray | None:
+        fname = record.get("deletes")
+        if not fname:
+            return None
+        with open(self._delete_mask_path(table, shard_id, fname), "rb") as f:
+            return np.load(f)
+
+    def apply_dml(self, table: str,
+                  deletes: dict[int, dict[str, np.ndarray]],
+                  pending: list[tuple[int, dict]] = ()) -> None:
+        """Atomically apply a DML effect: per-stripe delete masks (True =
+        row now dead) plus newly written (commit=False) stripes, all made
+        visible by a single manifest write.  Delete-mask files are
+        versioned, never overwritten in place, so a crash before the
+        manifest flip leaves only orphan files."""
+        with self._lock:
+            self.save_dictionaries(table)
+            man = self.manifest(table)
+            stale: list[str] = []
+            for shard_id, per_stripe in deletes.items():
+                records = man["shards"].get(str(shard_id), [])
+                by_file = {r["file"]: r for r in records}
+                for fname, mask in per_stripe.items():
+                    if not mask.any():
+                        continue
+                    rec = by_file[fname]
+                    if len(mask) != rec["rows"]:
+                        raise ValueError(
+                            f"{table}/{fname}: delete mask length "
+                            f"{len(mask)} != stripe rows {rec['rows']}")
+                    old = self.load_delete_mask(table, shard_id, rec)
+                    combined = mask if old is None else (old | mask)
+                    version = rec.get("del_version", 0) + 1
+                    delname = f"{fname}.del{version:04d}.npy"
+                    path = self._delete_mask_path(table, shard_id, delname)
+                    tmp = path + ".tmp"
+                    with open(tmp, "wb") as f:
+                        np.save(f, combined)
+                        f.flush()
+                        os.fsync(f.fileno())
+                    os.replace(tmp, path)
+                    if rec.get("deletes"):
+                        stale.append(self._delete_mask_path(
+                            table, shard_id, rec["deletes"]))
+                    rec["deletes"] = delname
+                    rec["del_version"] = version
+                    rec["live_rows"] = int((~combined).sum())
+            for shard_id, record in pending:
+                man["shards"].setdefault(str(shard_id), []).append(record)
+                stripe_no = int(record["file"].split("_")[1].split(".")[0])
+                man["next_stripe"] = max(man["next_stripe"], stripe_no + 1)
+            self._save_manifest(table)
+            for path in stale:
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+
+    def shard_stripe_records(self, table: str, shard_id: int) -> list[dict]:
+        man = self.manifest(table)
+        return [dict(r) for r in man["shards"].get(str(shard_id), [])]
+
+    def read_stripe_raw(self, table: str, shard_id: int, fname: str,
+                        columns: list[str] | None = None,
+                        record: dict | None = None,
+                        ) -> tuple[dict, dict, int, np.ndarray | None]:
+        """Read one stripe WITHOUT applying its deletion bitmap; returns
+        (values, validity, rows, delete_mask|None) so DML sees physical
+        row positions.  Pass the manifest `record` (from
+        shard_stripe_records) to skip the manifest rescan."""
+        if record is None:
+            man = self.manifest(table)
+            record = next(r for r in man["shards"].get(str(shard_id), [])
+                          if r["file"] == fname)
+        path = os.path.join(self.shard_dir(table, shard_id), fname)
+        vals, mask, n = StripeReader(path).read(columns)
+        return vals, mask, n, self.load_delete_mask(table, shard_id, record)
+
     def discard_pending(self, table: str,
                         pending: list[tuple[int, dict]]) -> None:
         with self._lock:
@@ -160,7 +249,8 @@ class TableStore:
 
     def shard_row_count(self, table: str, shard_id: int) -> int:
         man = self.manifest(table)
-        return sum(r["rows"] for r in man["shards"].get(str(shard_id), []))
+        return sum(r.get("live_rows", r["rows"])
+                   for r in man["shards"].get(str(shard_id), []))
 
     def shard_size_bytes(self, table: str, shard_id: int) -> int:
         man = self.manifest(table)
@@ -168,7 +258,8 @@ class TableStore:
 
     def table_row_count(self, table: str) -> int:
         man = self.manifest(table)
-        return sum(r["rows"] for recs in man["shards"].values() for r in recs)
+        return sum(r.get("live_rows", r["rows"])
+                   for recs in man["shards"].values() for r in recs)
 
     def read_shard(self, table: str, shard_id: int,
                    columns: list[str] | None = None, chunk_filter=None,
@@ -176,12 +267,23 @@ class TableStore:
         """Concatenate all visible stripes of one shard (projected)."""
         meta = self.catalog.table(table)
         columns = columns or meta.schema.names
-        paths = self.shard_stripe_paths(table, shard_id)
+        man = self.manifest(table)
+        records = man["shards"].get(str(shard_id), [])
         vals: dict[str, list[np.ndarray]] = {c: [] for c in columns}
         mask: dict[str, list[np.ndarray]] = {c: [] for c in columns}
         total = 0
-        for p in paths:
-            v, m, n = StripeReader(p).read(columns, chunk_filter)
+        for rec in records:
+            p = os.path.join(self.shard_dir(table, shard_id), rec["file"])
+            dmask = self.load_delete_mask(table, shard_id, rec)
+            # a stripe with deletions reads whole (positions must align with
+            # the bitmap), trading its chunk skipping for correctness
+            v, m, n = StripeReader(p).read(
+                columns, None if dmask is not None else chunk_filter)
+            if dmask is not None:
+                keep = ~dmask
+                v = {c: a[keep] for c, a in v.items()}
+                m = {c: a[keep] for c, a in m.items()}
+                n = int(keep.sum())
             total += n
             for c in columns:
                 vals[c].append(v[c])
@@ -210,9 +312,14 @@ class TableStore:
         for p, rec in zip(paths, records):
             shutil.copy2(p, os.path.join(
                 dest_store.shard_dir(table, shard_id), rec["file"]))
+            if rec.get("deletes"):
+                shutil.copy2(
+                    self._delete_mask_path(table, shard_id, rec["deletes"]),
+                    dest_store._delete_mask_path(table, shard_id,
+                                                 rec["deletes"]))
         with dest_store._lock:
             dman = dest_store.manifest(table)
             dman["shards"][str(shard_id)] = [dict(r) for r in records]
             dman["next_stripe"] = max(dman["next_stripe"], man["next_stripe"])
             dest_store._save_manifest(table)
-        return sum(r["rows"] for r in records)
+        return sum(r.get("live_rows", r["rows"]) for r in records)
