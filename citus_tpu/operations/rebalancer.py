@@ -105,10 +105,23 @@ def plan_rebalance(catalog: Catalog, store: TableStore,
 def rebalance_table_shards(catalog: Catalog, store: TableStore,
                            threshold: float = 0.1,
                            improvement_threshold: float = 0.5,
-                           ) -> list[PlacementUpdate]:
-    """Plan + apply (rebalance_table_shards UDF)."""
+                           progress=None) -> list[PlacementUpdate]:
+    """Plan + apply (rebalance_table_shards UDF).  `progress` is an
+    optional stats.ProgressRegistry (get_rebalance_progress analogue)."""
     moves = plan_rebalance(catalog, store, threshold, improvement_threshold)
-    for mv in moves:
-        target = catalog.nodes[mv.target_node]
-        move_shard_placement(catalog, store, mv.shard_id, target.name)
+    mon = (progress.create("rebalance", "all", len(moves))
+           if progress is not None and moves else None)
+    try:
+        for mv in moves:
+            target = catalog.nodes[mv.target_node]
+            move_shard_placement(catalog, store, mv.shard_id, target.name)
+            if mon is not None:
+                mon.advance(1, f"moved shard {mv.shard_id}")
+    except Exception:
+        if mon is not None:
+            mon.detail = "failed"
+            mon.finished = True
+        raise
+    if mon is not None:
+        mon.finish()
     return moves

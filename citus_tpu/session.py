@@ -42,7 +42,11 @@ from .types import ColumnDef, DataType, TableSchema, sql_type_to_datatype
 
 _UDFS = ("create_distributed_table", "create_reference_table",
          "citus_add_node", "citus_remove_node", "rebalance_table_shards",
-         "citus_move_shard_placement", "citus_get_node_clock")
+         "citus_move_shard_placement", "citus_get_node_clock",
+         "citus_stat_counters", "citus_stat_counters_reset",
+         "citus_stat_statements", "citus_stat_statements_reset",
+         "citus_stat_tenants", "citus_stat_activity",
+         "get_rebalance_progress")
 
 
 class _StoreStats(StatsProvider):
@@ -82,17 +86,56 @@ class Session:
                 self.catalog.add_node(f"device:{i}")
         self._temp_counter = 0
         from .executor.runner import Executor
+        from .stats import SessionStats
 
+        self.stats = SessionStats()
         self.executor = Executor(self.catalog, self.store, self.settings,
                                  self.mesh)
 
     # -- public API --------------------------------------------------------
     def execute(self, sql: str):
         """Run a SQL script; returns the last statement's ResultSet/None."""
+        import time as _time
+
+        from .stats import extract_tenants
+
         result = None
-        for stmt in parse(sql):
-            result = self._execute_statement(stmt)
+        tenant_hits: list[tuple[str, object]] = []
+        with self.stats.activity.track(sql):
+            t0 = _time.perf_counter()
+            for stmt in parse(sql):
+                result = self._execute_statement(stmt)
+                self._count_statement(stmt, result)
+                tenant_hits.extend(extract_tenants(stmt, self.catalog))
+            elapsed_ms = (_time.perf_counter() - t0) * 1000.0
+        rows = getattr(result, "row_count", 0) if result is not None else 0
+        self.stats.queries.record(sql, elapsed_ms, rows)
+        for table, tenant in tenant_hits:
+            self.stats.tenants.record(table, tenant, elapsed_ms)
         return result
+
+    def _count_statement(self, stmt: ast.Statement, result) -> None:
+        from .stats import counters as sc
+
+        c = self.stats.counters
+        if isinstance(stmt, ast.Select):
+            if (not stmt.from_items and len(stmt.items) == 1
+                    and isinstance(stmt.items[0].expr, ast.FuncCall)
+                    and stmt.items[0].expr.name in _UDFS):
+                return  # admin UDF calls aren't query traffic
+            if result is not None:
+                c.increment(sc.ROWS_RETURNED, result.row_count)
+                c.increment(sc.CAPACITY_RETRIES, result.retries)
+                c.increment(sc.DEVICE_ROWS_SCANNED,
+                            result.device_rows_scanned)
+        elif isinstance(stmt, ast.Update):
+            c.increment(sc.DML_UPDATE)
+        elif isinstance(stmt, ast.Delete):
+            c.increment(sc.DML_DELETE)
+        elif isinstance(stmt, ast.Merge):
+            c.increment(sc.DML_MERGE)
+        elif isinstance(stmt, (ast.CreateTable, ast.DropTable)):
+            c.increment(sc.DDL_COMMANDS)
 
     def create_distributed_table(self, name: str, distribution_column: str,
                                  shard_count: int | None = None,
@@ -140,6 +183,8 @@ class Session:
             return self._execute_insert_values(stmt)
         if isinstance(stmt, ast.InsertSelect):
             return self._execute_insert_select(stmt)
+        if isinstance(stmt, (ast.Update, ast.Delete, ast.Merge)):
+            return self._execute_dml(stmt)
         if isinstance(stmt, ast.CopyFrom):
             from .ingest.copy_from import copy_from
 
@@ -192,7 +237,8 @@ class Session:
         elif e.name == "rebalance_table_shards":
             from .operations.rebalancer import rebalance_table_shards
 
-            moves = rebalance_table_shards(self.catalog, self.store)
+            moves = rebalance_table_shards(self.catalog, self.store,
+                                           progress=self.stats.progress)
             self._save_catalog()
             return ResultSet(["moves"], {"moves": [len(moves)]}, 1)
         elif e.name == "citus_move_shard_placement":
@@ -205,6 +251,51 @@ class Session:
             from .transaction.clock import global_clock
 
             return ResultSet(["clock"], {"clock": [global_clock.now()]}, 1)
+        elif e.name == "citus_stat_counters":
+            snap = self.stats.counters.snapshot()
+            names = sorted(snap)
+            return ResultSet(["name", "value"],
+                             {"name": names,
+                              "value": [snap[n] for n in names]}, len(names))
+        elif e.name == "citus_stat_counters_reset":
+            self.stats.counters.reset()
+        elif e.name == "citus_stat_statements":
+            entries = self.stats.queries.entries()
+            return ResultSet(
+                ["query", "calls", "total_time_ms", "rows"],
+                {"query": [s.query for s in entries],
+                 "calls": [s.calls for s in entries],
+                 "total_time_ms": [round(s.total_time_ms, 3)
+                                   for s in entries],
+                 "rows": [s.rows for s in entries]}, len(entries))
+        elif e.name == "citus_stat_statements_reset":
+            self.stats.queries.reset()
+        elif e.name == "citus_stat_tenants":
+            entries = self.stats.tenants.entries()
+            return ResultSet(
+                ["table_name", "tenant_attribute", "query_count",
+                 "total_time_ms"],
+                {"table_name": [s.table for s in entries],
+                 "tenant_attribute": [s.tenant for s in entries],
+                 "query_count": [s.query_count for s in entries],
+                 "total_time_ms": [round(s.total_time_ms, 3)
+                                   for s in entries]}, len(entries))
+        elif e.name == "citus_stat_activity":
+            entries = self.stats.activity.entries()
+            return ResultSet(
+                ["global_pid", "query", "state"],
+                {"global_pid": [a.gpid for a in entries],
+                 "query": [a.query for a in entries],
+                 "state": [a.state for a in entries]}, len(entries))
+        elif e.name == "get_rebalance_progress":
+            mons = self.stats.progress.all()
+            return ResultSet(
+                ["operation", "target", "progress", "total", "detail"],
+                {"operation": [m.operation for m in mons],
+                 "target": [m.target for m in mons],
+                 "progress": [m.done_steps for m in mons],
+                 "total": [m.total_steps for m in mons],
+                 "detail": [m.detail for m in mons]}, len(mons))
         return ResultSet(["ok"], {"ok": [True]}, 1)
 
     # -- DDL ---------------------------------------------------------------
@@ -264,14 +355,67 @@ class Session:
         rows = [list(r) for r in result.rows()]
         return insert_rows(self, stmt.table, columns, rows)
 
+    def _execute_dml(self, stmt):
+        """UPDATE / DELETE / MERGE — router-planned modify commands
+        (CreateModifyPlan / merge_planner analogues).  Subqueries in the
+        WHERE clause go through recursive planning first, like SELECT."""
+        from .executor.dml import execute_delete, execute_merge, execute_update
+
+        cleanup: list[str] = []
+        try:
+            if isinstance(stmt, (ast.Update, ast.Delete)) and \
+                    stmt.where is not None:
+                stmt = dc_replace(stmt, where=self._rewrite_expr(
+                    stmt.where, cleanup, {}))
+            if isinstance(stmt, ast.Update):
+                return execute_update(self, stmt)
+            if isinstance(stmt, ast.Delete):
+                return execute_delete(self, stmt)
+            return execute_merge(self, stmt)
+        finally:
+            for t in cleanup:
+                self._drop_temp(t)
+
     # -- SELECT ------------------------------------------------------------
     def _execute_select(self, sel: ast.Select):
+        plan, cleanup = self._plan_select(sel)
+        self._count_plan_shape(plan)
+        try:
+            return self.executor.execute_plan(plan)
+        finally:
+            for t in cleanup:
+                self._drop_temp(t)
+
+    def _execute_subselect(self, sel: ast.Select):
+        """Nested (recursive-planning / MERGE-source) execution: counts as
+        a subplan, not as user query traffic."""
+        from .stats import counters as sc
+
+        self.stats.counters.increment(sc.SUBPLANS_EXECUTED)
         plan, cleanup = self._plan_select(sel)
         try:
             return self.executor.execute_plan(plan)
         finally:
             for t in cleanup:
                 self._drop_temp(t)
+
+    def _count_plan_shape(self, plan: QueryPlan) -> None:
+        from .executor.feed import walk_plan
+        from .planner.plan import JoinNode, ScanNode
+        from .stats import counters as sc
+
+        scans = [n for n in walk_plan(plan.root) if isinstance(n, ScanNode)]
+        repartition = any(
+            isinstance(n, JoinNode) and n.strategy.startswith("repart")
+            for n in walk_plan(plan.root))
+        single_shard = all(n.pruned_shards is not None
+                           and len(n.pruned_shards) <= 1 for n in scans)
+        if repartition:
+            self.stats.counters.increment(sc.QUERIES_REPARTITION)
+        if single_shard and scans:
+            self.stats.counters.increment(sc.QUERIES_SINGLE_SHARD)
+        else:
+            self.stats.counters.increment(sc.QUERIES_MULTI_SHARD)
 
     def _plan_select(self, sel: ast.Select) -> tuple[QueryPlan, list[str]]:
         cleanup: list[str] = []
@@ -301,6 +445,9 @@ class Session:
                 lines.append(f"Rows: {result.row_count}"
                              + (f" (capacity retries: {result.retries})"
                                 if result.retries else ""))
+                if result.device_rows_scanned:
+                    lines.append("Device Rows Scanned: "
+                                 f"{result.device_rows_scanned}")
             return ResultSet(["QUERY PLAN"], {"QUERY PLAN": lines},
                              len(lines))
         finally:
@@ -354,7 +501,7 @@ class Session:
     def _rewrite_expr(self, e: ast.Expr, cleanup, cte_scope) -> ast.Expr:
         if isinstance(e, ast.ScalarSubquery):
             inner = self._recursive_plan(e.query, cleanup, cte_scope)
-            result = self._execute_select(inner)
+            result = self._execute_subselect(inner)
             if result.row_count > 1:
                 raise ExecutionError(
                     "scalar subquery returned more than one row")
@@ -364,7 +511,7 @@ class Session:
             return _value_to_literal(result.rows()[0][0], dt)
         if isinstance(e, ast.InSubquery):
             inner = self._recursive_plan(e.query, cleanup, cte_scope)
-            result = self._execute_select(inner)
+            result = self._execute_subselect(inner)
             dt = _result_dtype(result, 0)
             raw = [r[0] for r in result.rows()]
             has_null = any(v is None for v in raw)
@@ -386,7 +533,7 @@ class Session:
         if isinstance(e, ast.Exists):
             inner = self._recursive_plan(e.query, cleanup, cte_scope)
             limited = dc_replace(inner, limit=1)
-            result = self._execute_select(limited)
+            result = self._execute_subselect(limited)
             found = result.row_count > 0
             return ast.Literal(found != e.negated)
         # structural recursion
@@ -427,7 +574,7 @@ class Session:
                      column_names: tuple[str, ...] = ()) -> str:
         """Execute a subquery and store its rows as a temp reference table
         (the intermediate-result broadcast analogue)."""
-        result = self._execute_select(sel)
+        result = self._execute_subselect(sel)
         self._temp_counter += 1
         name = f"__intermediate_{self._temp_counter}"
         names = (list(column_names) if column_names

@@ -1,1 +1,26 @@
+"""Observability: counters, per-query stats, tenant stats, progress,
+activity — the reference's stats/ + progress/ subsystems (SURVEY §2.10)."""
 
+from .activity import ActivityRegistry
+from .counters import ALL_COUNTERS, StatCounters
+from .progress import ProgressMonitor, ProgressRegistry
+from .query_stats import QueryStats, fingerprint
+from .tenants import TenantStats, extract_tenants
+
+
+class SessionStats:
+    """Bundle owned by each Session (the shared-memory segment analogue)."""
+
+    def __init__(self):
+        self.counters = StatCounters()
+        self.queries = QueryStats()
+        self.tenants = TenantStats()
+        self.progress = ProgressRegistry()
+        self.activity = ActivityRegistry()
+
+
+__all__ = [
+    "ALL_COUNTERS", "ActivityRegistry", "ProgressMonitor",
+    "ProgressRegistry", "QueryStats", "SessionStats", "StatCounters",
+    "TenantStats", "extract_tenants", "fingerprint",
+]

@@ -1,0 +1,55 @@
+"""Active-statement tracking — citus_stat_activity / global PID analogue.
+
+The reference assigns every backend a globally unique gpid (nodeId ·
+10^10 + pid, /root/reference/src/backend/distributed/transaction/
+backend_data.c) and unions per-node pg_stat_activity into cluster views.
+Single-controller equivalent: session-scoped gpids + a live registry of
+executing statements."""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from contextlib import contextmanager
+
+GPID_NODE_FACTOR = 10_000_000_000  # reference encoding: nodeid*10^10 + pid
+
+
+def make_gpid(node_id: int, pid: int | None = None) -> int:
+    return node_id * GPID_NODE_FACTOR + (pid if pid is not None
+                                         else os.getpid())
+
+
+@dataclass
+class ActivityEntry:
+    gpid: int
+    query: str
+    state: str = "active"
+    started_at: float = field(default_factory=time.time)
+
+
+class ActivityRegistry:
+    def __init__(self, node_id: int = 0):
+        self.node_id = node_id
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._active: dict[int, ActivityEntry] = {}
+
+    @contextmanager
+    def track(self, query: str):
+        with self._lock:
+            self._seq += 1
+            key = self._seq
+            entry = ActivityEntry(make_gpid(self.node_id), query[:1024])
+            self._active[key] = entry
+        try:
+            yield entry
+        finally:
+            with self._lock:
+                self._active.pop(key, None)
+
+    def entries(self) -> list[ActivityEntry]:
+        with self._lock:
+            return list(self._active.values())

@@ -1,0 +1,70 @@
+"""Cluster stat counters — citus_stat_counters analogue.
+
+The reference keeps lock-free per-backend counter slots in shared memory,
+aggregated into a per-database hash when backends exit
+(/root/reference/src/backend/distributed/stats/stat_counters.c, README
+§"stat counters").  Here the slot design maps to threads: each thread
+increments its private slot without locking; snapshots sum across slots.
+Slots are kept for the registry's lifetime (sessions are not expected to
+churn thousands of threads).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+
+# counter names (the reference's are connection/query-execution oriented;
+# ours mirror the TPU execution paths)
+QUERIES_SINGLE_SHARD = "queries_single_shard"
+QUERIES_MULTI_SHARD = "queries_multi_shard"
+QUERIES_REPARTITION = "queries_repartition"
+SUBPLANS_EXECUTED = "subplans_executed"
+ROWS_INGESTED = "rows_ingested"
+ROWS_RETURNED = "rows_returned"
+DML_UPDATE = "dml_update_count"
+DML_DELETE = "dml_delete_count"
+DML_MERGE = "dml_merge_count"
+DDL_COMMANDS = "ddl_commands"
+CAPACITY_RETRIES = "capacity_retries"
+DEVICE_ROWS_SCANNED = "device_rows_scanned"
+
+ALL_COUNTERS = [
+    QUERIES_SINGLE_SHARD, QUERIES_MULTI_SHARD, QUERIES_REPARTITION,
+    SUBPLANS_EXECUTED, ROWS_INGESTED, ROWS_RETURNED,
+    DML_UPDATE, DML_DELETE, DML_MERGE, DDL_COMMANDS,
+    CAPACITY_RETRIES, DEVICE_ROWS_SCANNED,
+]
+
+
+class StatCounters:
+    def __init__(self):
+        self._local = threading.local()
+        self._slots_lock = threading.Lock()
+        self._slots: list[defaultdict] = []
+
+    def _slot(self) -> defaultdict:
+        slot = getattr(self._local, "slot", None)
+        if slot is None:
+            slot = defaultdict(int)
+            self._local.slot = slot
+            with self._slots_lock:
+                self._slots.append(slot)
+        return slot
+
+    def increment(self, name: str, by: int = 1) -> None:
+        self._slot()[name] += by
+
+    def snapshot(self) -> dict[str, int]:
+        with self._slots_lock:
+            slots = list(self._slots)
+        out: dict[str, int] = {}
+        for slot in slots:
+            for k, v in slot.items():
+                out[k] = out.get(k, 0) + v
+        return {k: out.get(k, 0) for k in ALL_COUNTERS}
+
+    def reset(self) -> None:
+        with self._slots_lock:
+            for slot in self._slots:
+                slot.clear()

@@ -1,0 +1,126 @@
+"""Observability tests — stat counters, query stats, tenant stats,
+activity, progress (reference: stats/stat_counters.c, query_stats.c,
+stat_tenants.c, progress/multi_progress.c)."""
+
+import citus_tpu
+import pytest
+
+from citus_tpu.stats import fingerprint
+from citus_tpu.stats.counters import StatCounters
+
+
+@pytest.fixture
+def sess(tmp_path):
+    s = citus_tpu.connect(data_dir=str(tmp_path / "stats"), n_devices=4)
+    s.execute("""
+        create table events (tenant int, kind text, n int);
+        select create_distributed_table('events', 'tenant', 8);
+        insert into events values (1, 'a', 10), (1, 'b', 20), (2, 'a', 5),
+                                  (3, 'c', 1);
+    """)
+    return s
+
+
+def _counters(sess):
+    r = sess.execute("select citus_stat_counters()")
+    return dict(zip(r.columns["name"], r.columns["value"]))
+
+
+def test_counters_track_queries_and_dml(sess):
+    sess.execute("select count(*) from events")            # multi-shard
+    sess.execute("select n from events where tenant = 1")  # single-shard
+    sess.execute("update events set n = n + 1 where tenant = 2")
+    sess.execute("delete from events where tenant = 3")
+    c = _counters(sess)
+    assert c["queries_multi_shard"] >= 1
+    assert c["queries_single_shard"] >= 1
+    assert c["dml_update_count"] == 1
+    assert c["dml_delete_count"] == 1
+    assert c["rows_ingested"] == 4
+    assert c["rows_returned"] >= 2
+    assert c["ddl_commands"] >= 1
+
+
+def test_counters_track_repartition(sess):
+    sess.execute("select count(*) from events a, events b "
+                 "where a.n = b.tenant")
+    assert _counters(sess)["queries_repartition"] >= 1
+
+
+def test_counters_reset(sess):
+    sess.execute("select count(*) from events")
+    sess.execute("select citus_stat_counters_reset()")
+    c = _counters(sess)
+    assert all(v == 0 for k, v in c.items())
+
+
+def test_query_stats_fingerprint_groups_literals():
+    assert fingerprint("select * from t where a = 42") == \
+        fingerprint("SELECT * FROM t WHERE a = 99")
+    assert fingerprint("select * from t where s = 'x'") == \
+        fingerprint("select * from t where s = 'other'")
+    assert fingerprint("select * from t1") != fingerprint("select * from t2")
+
+
+def test_stat_statements_records_calls(sess):
+    for k in (1, 2, 3):
+        sess.execute(f"select sum(n) from events where tenant = {k}")
+    r = sess.execute("select citus_stat_statements()")
+    by_q = dict(zip(r.columns["query"], r.columns["calls"]))
+    hit = [q for q in by_q if "sum ( n )" in q or "sum(n)" in q.replace(" ", "")]
+    assert hit and by_q[hit[0]] == 3
+    sess.execute("select citus_stat_statements_reset()")
+    r = sess.execute("select citus_stat_statements()")
+    assert r.row_count <= 1  # only the reset/view calls themselves
+
+
+def test_stat_tenants_attribution(sess):
+    sess.execute("select n from events where tenant = 1")
+    sess.execute("select n from events where tenant = 1")
+    sess.execute("select n from events where tenant = 2")
+    r = sess.execute("select citus_stat_tenants()")
+    rows = {(t, a): c for t, a, c, _ in r.rows()}
+    assert rows[("events", "1")] == 2
+    assert rows[("events", "2")] == 1
+
+
+def test_stat_counters_thread_slots():
+    import threading
+
+    c = StatCounters()
+
+    def work():
+        for _ in range(1000):
+            c.increment("x")
+
+    threads = [threading.Thread(target=work) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    # "x" is not a registered counter name; snapshot only reports known
+    # ones — check the raw aggregation instead
+    total = sum(slot.get("x", 0) for slot in c._slots)
+    assert total == 4000
+
+
+def test_rebalance_reports_progress(tmp_path):
+    s = citus_tpu.connect(data_dir=str(tmp_path / "rb"), n_devices=2)
+    s.execute("""
+        create table big (k int, v int);
+        select create_distributed_table('big', 'k', 8);
+    """)
+    s.execute("insert into big values " + ", ".join(
+        f"({i}, {i})" for i in range(200)))
+    s.execute("select citus_add_node('device:extra')")
+    s.execute("select rebalance_table_shards('big')")
+    r = s.execute("select get_rebalance_progress()")
+    if r.row_count:  # moves happened: every monitor completed
+        assert all(p == t for p, t in
+                   zip(r.columns["progress"], r.columns["total"]))
+
+
+def test_explain_analyze_reports_device_rows(sess):
+    r = sess.execute("explain analyze select sum(n) from events")
+    text = "\n".join(r.columns["QUERY PLAN"])
+    assert "Execution Time" in text
