@@ -40,6 +40,10 @@ class TableStore:
         self._lock = threading.RLock()
         self._manifests: dict[str, dict] = {}
         self._dicts: dict[tuple[str, str], Dictionary] = {}
+        # read-your-writes overlay, set by an open transaction
+        # (transaction.manager.Transaction): staged-but-uncommitted stripe
+        # records and deletion masks folded into every read
+        self.overlay = None
         os.makedirs(os.path.join(data_dir, "tables"), exist_ok=True)
 
     # -- paths -------------------------------------------------------------
@@ -160,6 +164,27 @@ class TableStore:
         with open(self._delete_mask_path(table, shard_id, fname), "rb") as f:
             return np.load(f)
 
+    # -- transaction overlay ----------------------------------------------
+    def _overlay_records(self, table: str, shard_id: int) -> list[dict]:
+        if self.overlay is None:
+            return []
+        return self.overlay.records.get((table, shard_id), [])
+
+    def _overlay_mask(self, table: str, shard_id: int,
+                      fname: str) -> np.ndarray | None:
+        if self.overlay is None:
+            return None
+        return self.overlay.deletes.get((table, shard_id, fname))
+
+    def effective_delete_mask(self, table: str, shard_id: int,
+                              record: dict) -> np.ndarray | None:
+        """On-disk deletion bitmap OR the open transaction's staged one."""
+        disk = self.load_delete_mask(table, shard_id, record)
+        staged = self._overlay_mask(table, shard_id, record["file"])
+        if staged is None:
+            return disk
+        return staged if disk is None else (disk | staged)
+
     def apply_dml(self, table: str,
                   deletes: dict[int, dict[str, np.ndarray]],
                   pending: list[tuple[int, dict]] = ()) -> None:
@@ -172,6 +197,15 @@ class TableStore:
             self.save_dictionaries(table)
             man = self.manifest(table)
             stale: list[str] = []
+            # pending stripes first so a staged delete may target a stripe
+            # committed by this very call (transactional UPDATE-after-INSERT)
+            for shard_id, record in pending:
+                recs = man["shards"].setdefault(str(shard_id), [])
+                if any(r["file"] == record["file"] for r in recs):
+                    continue  # crash-recovery replay: already applied
+                recs.append(record)
+                stripe_no = int(record["file"].split("_")[1].split(".")[0])
+                man["next_stripe"] = max(man["next_stripe"], stripe_no + 1)
             for shard_id, per_stripe in deletes.items():
                 records = man["shards"].get(str(shard_id), [])
                 by_file = {r["file"]: r for r in records}
@@ -200,10 +234,6 @@ class TableStore:
                     rec["deletes"] = delname
                     rec["del_version"] = version
                     rec["live_rows"] = int((~combined).sum())
-            for shard_id, record in pending:
-                man["shards"].setdefault(str(shard_id), []).append(record)
-                stripe_no = int(record["file"].split("_")[1].split(".")[0])
-                man["next_stripe"] = max(man["next_stripe"], stripe_no + 1)
             self._save_manifest(table)
             for path in stale:
                 try:
@@ -213,7 +243,8 @@ class TableStore:
 
     def shard_stripe_records(self, table: str, shard_id: int) -> list[dict]:
         man = self.manifest(table)
-        return [dict(r) for r in man["shards"].get(str(shard_id), [])]
+        return ([dict(r) for r in man["shards"].get(str(shard_id), [])]
+                + [dict(r) for r in self._overlay_records(table, shard_id)])
 
     def read_stripe_raw(self, table: str, shard_id: int, fname: str,
                         columns: list[str] | None = None,
@@ -224,12 +255,13 @@ class TableStore:
         row positions.  Pass the manifest `record` (from
         shard_stripe_records) to skip the manifest rescan."""
         if record is None:
-            man = self.manifest(table)
-            record = next(r for r in man["shards"].get(str(shard_id), [])
+            record = next(r for r in self.shard_stripe_records(table,
+                                                               shard_id)
                           if r["file"] == fname)
         path = os.path.join(self.shard_dir(table, shard_id), fname)
         vals, mask, n = StripeReader(path).read(columns)
-        return vals, mask, n, self.load_delete_mask(table, shard_id, record)
+        return vals, mask, n, self.effective_delete_mask(table, shard_id,
+                                                         record)
 
     def discard_pending(self, table: str,
                         pending: list[tuple[int, dict]]) -> None:
@@ -249,8 +281,19 @@ class TableStore:
 
     def shard_row_count(self, table: str, shard_id: int) -> int:
         man = self.manifest(table)
-        return sum(r.get("live_rows", r["rows"])
-                   for r in man["shards"].get(str(shard_id), []))
+        total = 0
+        for r in man["shards"].get(str(shard_id), []):
+            total += r.get("live_rows", r["rows"])
+            staged = self._overlay_mask(table, shard_id, r["file"])
+            if staged is not None:
+                disk = self.load_delete_mask(table, shard_id, r)
+                newly = staged if disk is None else (staged & ~disk)
+                total -= int(newly.sum())
+        for r in self._overlay_records(table, shard_id):
+            staged = self._overlay_mask(table, shard_id, r["file"])
+            total += (r["rows"] if staged is None
+                      else int((~staged).sum()))
+        return total
 
     def shard_size_bytes(self, table: str, shard_id: int) -> int:
         man = self.manifest(table)
@@ -258,8 +301,12 @@ class TableStore:
 
     def table_row_count(self, table: str) -> int:
         man = self.manifest(table)
-        return sum(r.get("live_rows", r["rows"])
-                   for recs in man["shards"].values() for r in recs)
+        if self.overlay is None:
+            return sum(r.get("live_rows", r["rows"])
+                       for recs in man["shards"].values() for r in recs)
+        return sum(self.shard_row_count(table, int(sid))
+                   for sid in set(man["shards"])
+                   | {str(s) for t, s in self.overlay.records if t == table})
 
     def read_shard(self, table: str, shard_id: int,
                    columns: list[str] | None = None, chunk_filter=None,
@@ -268,13 +315,14 @@ class TableStore:
         meta = self.catalog.table(table)
         columns = columns or meta.schema.names
         man = self.manifest(table)
-        records = man["shards"].get(str(shard_id), [])
+        records = (list(man["shards"].get(str(shard_id), []))
+                   + self._overlay_records(table, shard_id))
         vals: dict[str, list[np.ndarray]] = {c: [] for c in columns}
         mask: dict[str, list[np.ndarray]] = {c: [] for c in columns}
         total = 0
         for rec in records:
             p = os.path.join(self.shard_dir(table, shard_id), rec["file"])
-            dmask = self.load_delete_mask(table, shard_id, rec)
+            dmask = self.effective_delete_mask(table, shard_id, rec)
             # a stripe with deletions reads whole (positions must align with
             # the bitmap), trading its chunk skipping for correctness
             v, m, n = StripeReader(p).read(
