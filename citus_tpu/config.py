@@ -85,6 +85,20 @@ _register(ConfigVar(
     "Static join-output headroom over probe-side capacity.",
     float, min_value=0.1, max_value=64.0))
 _register(ConfigVar(
+    "agg_group_capacity_factor", 1.5,
+    "Static aggregate-output headroom over the estimated group count.",
+    float, min_value=1.0, max_value=64.0))
+_register(ConfigVar(
+    "max_cached_plans", 256,
+    "Compiled-executable cache entries; a structurally repeated query "
+    "skips XLA trace+compile (ref: planner/local_plan_cache.c:1-60).",
+    int, min_value=0, max_value=100_000))
+_register(ConfigVar(
+    "max_cached_feed_bytes", 4 << 30,
+    "HBM byte budget for device-resident table feeds reused across "
+    "queries (ref: connection/pool reuse, executor/adaptive_executor.c:962).",
+    int, min_value=0, max_value=1 << 40))
+_register(ConfigVar(
     "enable_pallas_kernels", True,
     "Use hand-written Pallas TPU kernels for hot ops where available; "
     "fall back to pure XLA lowering otherwise.",

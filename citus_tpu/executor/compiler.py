@@ -53,6 +53,68 @@ def _round_cap(n: int) -> int:
     return max(128, int(math.ceil(n / 128.0)) * 128)
 
 
+def _scan_ids(plan: QueryPlan) -> list[int]:
+    from .feed import walk_plan
+
+    return [id(n) for n in walk_plan(plan.root) if isinstance(n, ScanNode)]
+
+
+def flatten_feed_arrays(plan: QueryPlan, feeds) -> list:
+    """Feed arrays in the exact order PlanCompiler.build consumes them —
+    lets a plan-cache hit skip rebuilding the compiler entirely."""
+    out = []
+    for node_id in _scan_ids(plan):
+        feed = feeds[node_id]
+        for cid in sorted(feed.arrays):
+            out.append(feed.arrays[cid])
+        for cid in sorted(feed.nulls):
+            out.append(feed.nulls[cid])
+        out.append(feed.valid)
+    return out
+
+
+def _to_bits64(a):
+    """Lossless device-side widening to int64 for the packed transfer.
+
+    64-bit bitcasts are not implemented by the TPU X64 rewriter, so f64
+    splits into two 32-bit bitcast words recombined arithmetically."""
+    if a.dtype == jnp.float64:
+        parts = jax.lax.bitcast_convert_type(a, jnp.uint32)  # [..., 2]
+        lo = parts[..., 0].astype(jnp.uint64)
+        hi = parts[..., 1].astype(jnp.uint64)
+        return ((hi << jnp.uint64(32)) | lo).astype(jnp.int64)
+    if a.dtype == jnp.float32:
+        # sign-extended int32 bits; host truncation recovers them exactly
+        return jax.lax.bitcast_convert_type(a, jnp.int32).astype(jnp.int64)
+    return a.astype(jnp.int64)
+
+
+def _from_bits64(arr: np.ndarray, dtype: np.dtype) -> np.ndarray:
+    if dtype == np.float64:
+        return arr.view(np.float64)
+    if dtype == np.float32:
+        return arr.astype(np.int32).view(np.float32)
+    if dtype == np.bool_:
+        return arr != 0
+    return arr.astype(dtype)
+
+
+def unpack_outputs(packed: np.ndarray, out_meta):
+    """Packed [n_out, n_dev, cap] int64 → (cols, nulls, valid) numpy."""
+    cols: dict[str, np.ndarray] = {}
+    nulls: dict[str, np.ndarray] = {}
+    valid = None
+    for i, (kind, cid, dt) in enumerate(out_meta):
+        arr = _from_bits64(packed[i], dt)
+        if kind == "col":
+            cols[cid] = arr
+        elif kind == "null":
+            nulls[cid] = arr
+        else:
+            valid = arr
+    return cols, nulls, valid
+
+
 @dataclass
 class FeedSpec:
     """Host-side data feed for one scan: arrays indexed like the plan."""
@@ -71,10 +133,19 @@ class Capacities:
 
     repartition: dict[int, int]
     join_out: dict[int, int]
+    # aggregate output slots (present only when the planner estimated the
+    # group count); segment_aggregate outputs slice down to this, shrinking
+    # shuffle buffers AND device→host result transfer
+    agg_out: dict[int, int] = None
+
+    def __post_init__(self):
+        if self.agg_out is None:
+            self.agg_out = {}
 
     def doubled(self) -> "Capacities":
         return Capacities({k: v * 2 for k, v in self.repartition.items()},
-                          {k: v * 2 for k, v in self.join_out.items()})
+                          {k: v * 2 for k, v in self.join_out.items()},
+                          {k: v * 2 for k, v in self.agg_out.items()})
 
 
 class PlanCompiler:
@@ -92,11 +163,23 @@ class PlanCompiler:
 
     # ------------------------------------------------------------------
     def build(self):
-        """Returns (jitted_fn, ordered_feed_arrays, in_specs)."""
+        """Returns (jitted_fn, ordered_feed_arrays, out_meta).
+
+        Feeds flatten in deterministic plan-walk order (NOT id() order) so
+        a cached executable can be re-fed by flatten_feed_arrays for a
+        structurally identical plan compiled in another execution.
+
+        The jitted fn returns (packed, overflow): every output column /
+        null mask / validity bitcast to int64 and stacked into ONE
+        [n_out, n_dev, cap] array, so fetching results costs two
+        device→host transfers total instead of one per column — on
+        remote-attached TPUs each transfer pays a full round trip.
+        out_meta describes how to unpack (see unpack_outputs)."""
         feed_arrays = []
         in_specs = []
         feed_index = {}
-        for node_id, feed in sorted(self.feeds.items()):
+        for node_id in _scan_ids(self.plan):
+            feed = self.feeds[node_id]
             names = []
             for cid in sorted(feed.arrays):
                 feed_arrays.append(feed.arrays[cid])
@@ -111,6 +194,8 @@ class PlanCompiler:
             names.append(("valid", ""))
             feed_index[node_id] = names
         self._feed_index = feed_index
+        self._feed_sharded = {nid: self.feeds[nid].sharded
+                              for nid in feed_index}
 
         out_cids = sorted(self.plan.root.out_columns)
         out_specs = ({c: P(SHARD_AXIS) for c in out_cids},
@@ -118,6 +203,11 @@ class PlanCompiler:
                      P(SHARD_AXIS), P(SHARD_AXIS))
 
         def body(*flat_feeds):
+            # trace-time device float policy: SQL float64 evaluates in the
+            # session compute dtype on device (see exprs.DEVICE_FLOAT64)
+            from . import exprs as _exprs
+
+            _exprs.DEVICE_FLOAT64 = np.dtype(self.compute_dtype)
             blocks = self._unpack_feeds(flat_feeds)
             self._overflow = jnp.zeros((), dtype=jnp.int64)
             out = self._exec(self.plan.root, blocks)
@@ -136,10 +226,33 @@ class PlanCompiler:
             return (cols, nulls, out.valid[None, :],
                     self._overflow.reshape(1))
 
-        fn = shard_map(body, mesh=self.mesh,
-                       in_specs=tuple(in_specs), out_specs=out_specs,
-                       check_vma=False)
-        return jax.jit(fn), feed_arrays
+        mapped = shard_map(body, mesh=self.mesh,
+                           in_specs=tuple(in_specs), out_specs=out_specs,
+                           check_vma=False)
+        # abstract-eval to learn output dtypes, then build the pack plan
+        shapes = jax.eval_shape(mapped, *feed_arrays)
+        s_cols, s_nulls, s_valid, _ = shapes
+        out_meta = []
+        for cid in out_cids:
+            out_meta.append(("col", cid, np.dtype(s_cols[cid].dtype)))
+        for cid in out_cids:
+            out_meta.append(("null", cid, np.dtype(s_nulls[cid].dtype)))
+        out_meta.append(("valid", "", np.dtype(s_valid.dtype)))
+
+        def packed_fn(*flat_feeds):
+            cols, nulls, valid, overflow = mapped(*flat_feeds)
+            rows = []
+            for kind, cid, _dt in out_meta:
+                arr = (cols[cid] if kind == "col"
+                       else nulls[cid] if kind == "null" else valid)
+                rows.append(_to_bits64(arr))
+            return jnp.stack(rows), overflow
+
+        # the cached executable closes over this compiler (via body); drop
+        # the FeedSpec device arrays so the plan cache pins only code +
+        # metadata, not every input table's HBM buffers
+        self.feeds = None
+        return jax.jit(packed_fn), feed_arrays, out_meta
 
     # ------------------------------------------------------------------
     def _unpack_feeds(self, flat_feeds) -> dict[int, Block]:
@@ -147,12 +260,12 @@ class PlanCompiler:
         i = 0
         flat = list(flat_feeds)
         for node_id, names in self._feed_index.items():
-            feed = self.feeds[node_id]
+            sharded = self._feed_sharded[node_id]
             cols, nulls, valid = {}, {}, None
             for kind, cid in names:
                 arr = flat[i]
                 i += 1
-                if feed.sharded:
+                if sharded:
                     arr = arr[0]  # shard_map gives [1, cap] per device
                 if kind == "col":
                     cols[cid] = arr
@@ -315,21 +428,8 @@ class PlanCompiler:
         return blk
 
     # -- aggregation ----------------------------------------------------
-    def _agg_inputs(self, node: AggregateNode, blk: Block):
-        """Evaluate group keys and aggregate inputs on the input block."""
-        key_arrays = []
-        key_meta = []  # (cid, dtype)
-        for g, cid in node.group_keys:
-            v, nmask = evaluate(g, _src(blk), jnp)
-            v = jnp.broadcast_to(v, blk.valid.shape)
-            key_arrays.append(v)
-            if nmask is not None:
-                # NULLs form their own group: null flag joins the key
-                key_arrays.append(
-                    jnp.broadcast_to(nmask, blk.valid.shape).astype(jnp.int32))
-                key_meta.append((cid, True))
-            else:
-                key_meta.append((cid, False))
+    def _agg_values(self, node: AggregateNode, blk: Block):
+        """Evaluate aggregate inputs → [(value, kind, contrib_valid)]."""
         values = []
         for a, cid in node.aggs:
             if a.kind == "count_star":
@@ -347,6 +447,24 @@ class PlanCompiler:
             vv = None if nmask is None else ~jnp.broadcast_to(
                 nmask, blk.valid.shape)
             values.append((v, kind, vv))
+        return values
+
+    def _agg_inputs(self, node: AggregateNode, blk: Block):
+        """Evaluate group keys and aggregate inputs on the input block."""
+        key_arrays = []
+        key_meta = []  # (cid, dtype)
+        for g, cid in node.group_keys:
+            v, nmask = evaluate(g, _src(blk), jnp)
+            v = jnp.broadcast_to(v, blk.valid.shape)
+            key_arrays.append(v)
+            if nmask is not None:
+                # NULLs form their own group: null flag joins the key
+                key_arrays.append(
+                    jnp.broadcast_to(nmask, blk.valid.shape).astype(jnp.int32))
+                key_meta.append((cid, True))
+            else:
+                key_meta.append((cid, False))
+        values = self._agg_values(node, blk)
         return key_arrays, key_meta, values
 
     def _exec_aggregate(self, node: AggregateNode, feeds) -> Block:
@@ -356,6 +474,9 @@ class PlanCompiler:
             blk = blk.with_filter(
                 jnp.broadcast_to(jax.lax.axis_index(SHARD_AXIS) == 0,
                                  blk.valid.shape))
+        if node.dense_keys is not None and node.combine in ("local",
+                                                           "repartition"):
+            return self._exec_dense_aggregate(node, blk)
         key_arrays, key_meta, values = self._agg_inputs(node, blk)
 
         if node.combine == "global":
@@ -402,8 +523,9 @@ class PlanCompiler:
             else:
                 companions.append(None)
         all_values = values + [c for c in companions if c is not None]
-        gk, res, gvalid, _ = segment_aggregate(key_arrays, all_values,
-                                               blk.valid)
+        gk, res, gvalid, ngroups = segment_aggregate(key_arrays, all_values,
+                                                     blk.valid)
+        gk, res, gvalid = self._slice_groups(node, gk, res, gvalid, ngroups)
         main_res = res[:len(values)]
         comp_res = res[len(values):]
         partial = self._partial_block(node, key_meta, gk, main_res, gvalid)
@@ -459,14 +581,156 @@ class PlanCompiler:
                 comp_cids.append(cid)
         for cid in comp_cids:
             values2.append((shuffled.columns[f"__cnt_{cid}"], "sum", None))
-        gk2, res2, gvalid2, _ = segment_aggregate(
+        gk2, res2, gvalid2, ngroups2 = segment_aggregate(
             key_arrays2, values2, shuffled.valid)
+        gk2, res2, gvalid2 = self._slice_groups(node, gk2, res2, gvalid2,
+                                                ngroups2)
         final = self._partial_block(node, key_meta, gk2,
                                     res2[:len(node.aggs)], gvalid2)
         for cid, cnt in zip(comp_cids, res2[len(node.aggs):]):
             final = Block(final.columns, final.valid,
                           {**final.nulls, cid: cnt == 0})
         return final
+
+    def _exec_dense_aggregate(self, node: AggregateNode, blk: Block) -> Block:
+        """Dense-grid aggregation: group keys with known small value ranges
+        map to one slot id; aggregation is unsorted stacked segment
+        reductions over [total_slots] and the cross-device combine is
+        psum/pmin/pmax — no sort, no all_to_all.  This is the TPU-native
+        replacement for the reference's worker hash-aggregate + coordinator
+        combine on low-cardinality GROUP BYs (multi_logical_optimizer.c):
+        static shapes, MXU/VPU-friendly, ICI collectives."""
+        specs = node.dense_keys
+        total = node.dense_total
+        n = blk.valid.shape[0]
+
+        # slot id per row (invalid rows → trash slot `total`)
+        slot = jnp.zeros(n, dtype=jnp.int32)
+        stride = 1
+        strides = []
+        for (g, _cid), (base, extent, has_null) in zip(node.group_keys,
+                                                       specs):
+            v, nmask = evaluate(g, _src(blk), jnp)
+            v = jnp.broadcast_to(v, (n,))
+            # subtract base in the key's own width FIRST — int64 keys with
+            # values past int32 would wrap if narrowed before rebasing
+            rebased = v - jnp.asarray(base, v.dtype)
+            idx = jnp.clip(rebased, 0, extent - 1).astype(jnp.int32)
+            nm = (jnp.broadcast_to(nmask, (n,)) if nmask is not None
+                  else None)
+            # a key outside the planned extent means the stats the grid
+            # was planned from went stale — surface as overflow (→ error
+            # after retries) rather than silently clipping into a group
+            oob = (rebased < 0) | (rebased >= extent)
+            if nm is not None:
+                oob = oob & ~nm
+            self._overflow = self._overflow + \
+                (oob & blk.valid).sum().astype(jnp.int64)
+            if has_null and nm is not None:
+                idx = jnp.where(nm, jnp.int32(extent), idx)
+            slot = slot + idx * stride
+            strides.append(stride)
+            stride *= extent + (1 if has_null else 0)
+        slot = jnp.where(blk.valid, slot, jnp.int32(total))
+
+        # value inputs (value, kind, contrib_valid) — counts in int32
+        # (int64 segment ops are emulated on TPU), widened after reduce
+        values = self._agg_values(node, blk)
+        rows_per_slot = jax.ops.segment_sum(
+            blk.valid.astype(jnp.int32), slot, num_segments=total + 1)[:total]
+
+        # stacked reductions: one segment op per (reduction kind, dtype)
+        results: list = [None] * len(values)
+        companions: list = [None] * len(values)
+        by_kind: dict[tuple, list[tuple[int, jnp.ndarray]]] = {}
+        for i, (v, kind, vv) in enumerate(values):
+            contrib = blk.valid if vv is None else (blk.valid & vv)
+            if kind == "count":
+                arr = contrib.astype(jnp.int32)
+                by_kind.setdefault(("sum", jnp.int32), []).append((i, arr))
+                continue
+            if kind == "sum":
+                z = jnp.zeros((), v.dtype)
+                arr = jnp.where(contrib, v, z)
+                by_kind.setdefault(("sum", v.dtype), []).append((i, arr))
+            elif kind == "min":
+                arr = jnp.where(contrib, v, _big(v.dtype))
+                by_kind.setdefault(("min", v.dtype), []).append((i, arr))
+            elif kind == "max":
+                arr = jnp.where(contrib, v, _small(v.dtype))
+                by_kind.setdefault(("max", v.dtype), []).append((i, arr))
+            else:
+                raise ExecutionError(f"bad agg kind {kind}")
+            # companion: non-NULL contribution count (all-NULL group → NULL)
+            comp = contrib.astype(jnp.int32)
+            by_kind.setdefault(("companion", jnp.int32), []).append((i, comp))
+        for (op, _dt), items in by_kind.items():
+            data = jnp.stack([a for _, a in items], axis=1)
+            if op in ("sum", "companion"):
+                red = jax.ops.segment_sum(data, slot,
+                                          num_segments=total + 1)
+            elif op == "min":
+                red = jax.ops.segment_min(data, slot,
+                                          num_segments=total + 1)
+            else:
+                red = jax.ops.segment_max(data, slot,
+                                          num_segments=total + 1)
+            red = red[:total]
+            for j, (i, _a) in enumerate(items):
+                if op == "companion":
+                    companions[i] = red[:, j]
+                else:
+                    results[i] = red[:, j]
+
+        # cross-device combine (repartition → collectives; local → none)
+        if node.combine == "repartition":
+            rows_per_slot = jax.lax.psum(rows_per_slot, SHARD_AXIS)
+            for i, (v, kind, _vv) in enumerate(values):
+                if kind in ("count", "sum"):
+                    results[i] = jax.lax.psum(results[i], SHARD_AXIS)
+                elif kind == "min":
+                    results[i] = jax.lax.pmin(results[i], SHARD_AXIS)
+                else:
+                    results[i] = jax.lax.pmax(results[i], SHARD_AXIS)
+                if companions[i] is not None:
+                    companions[i] = jax.lax.psum(companions[i], SHARD_AXIS)
+            out_valid = (rows_per_slot > 0) & \
+                (jax.lax.axis_index(SHARD_AXIS) == 0)
+        else:
+            out_valid = rows_per_slot > 0
+
+        # reconstruct key columns from the slot grid
+        iota = jnp.arange(total, dtype=jnp.int32)
+        cols: dict[str, jnp.ndarray] = {}
+        nulls: dict[str, jnp.ndarray] = {}
+        for (g, cid), (base, extent, has_null), st in zip(
+                node.group_keys, specs, strides):
+            ext = extent + (1 if has_null else 0)
+            idx = (iota // st) % ext
+            cols[cid] = (idx.clip(0, extent - 1).astype(jnp.int64)
+                         + base).astype(g.dtype.numpy_dtype)
+            if has_null:
+                nulls[cid] = idx == extent
+        for i, ((a, cid), (v, kind, _vv)) in enumerate(
+                zip(node.aggs, values)):
+            r = results[i]
+            if kind == "count":
+                r = r.astype(jnp.int64)
+            cols[cid] = r
+            if companions[i] is not None:
+                nulls[cid] = companions[i] == 0
+        return Block(cols, out_valid, nulls)
+
+    def _slice_groups(self, node: AggregateNode, gk, res, gvalid, ngroups):
+        """Slice front-packed group slots down to the planner's estimated
+        capacity; groups beyond it count as overflow (→ retry, doubled)."""
+        agg_cap = self.caps.agg_out.get(id(node))
+        if agg_cap is None or agg_cap >= gvalid.shape[0]:
+            return gk, res, gvalid
+        self._overflow = self._overflow + jnp.maximum(
+            ngroups.astype(jnp.int64) - agg_cap, 0)
+        return ([k[:agg_cap] for k in gk], [r[:agg_cap] for r in res],
+                gvalid[:agg_cap])
 
     def _partial_block(self, node: AggregateNode, key_meta, gk, res,
                        gvalid) -> Block:

@@ -106,18 +106,20 @@ def execute_delete(session, stmt: ast.Delete):
     cols = _pred_columns(bound, rel)
     deletes: dict[int, dict[str, np.ndarray]] = {}
     count = 0
-    for shard in _target_shards(session, stmt.table, rel, bound.conjuncts):
-        for rec in session.store.shard_stripe_records(stmt.table,
-                                                      shard.shard_id):
-            vals, valid, n, dmask = session.store.read_stripe_raw(
-                stmt.table, shard.shard_id, rec["file"], cols, rec)
-            mask = _match_mask(bound, rel, vals, valid, n, dmask)
-            hits = int(mask.sum())
-            if hits:
-                deletes.setdefault(shard.shard_id, {})[rec["file"]] = mask
-                count += hits
-    if deletes:
-        session.store.apply_dml(stmt.table, deletes)
+    shards = _target_shards(session, stmt.table, rel, bound.conjuncts)
+    with session._dml_locks(stmt.table, [s.shard_id for s in shards]):
+        for shard in shards:
+            for rec in session.store.shard_stripe_records(stmt.table,
+                                                          shard.shard_id):
+                vals, valid, n, dmask = session.store.read_stripe_raw(
+                    stmt.table, shard.shard_id, rec["file"], cols, rec)
+                mask = _match_mask(bound, rel, vals, valid, n, dmask)
+                hits = int(mask.sum())
+                if hits:
+                    deletes.setdefault(shard.shard_id, {})[rec["file"]] = mask
+                    count += hits
+        if deletes:
+            session._apply_dml(stmt.table, deletes, [])
     return _result(count, "DELETE")
 
 
@@ -179,22 +181,24 @@ def execute_update(session, stmt: ast.Update):
     codec = session.settings.get("columnar_compression")
     level = session.settings.get("columnar_compression_level")
     chunk_rows = session.settings.get("columnar_chunk_group_row_limit")
-    try:
-        count = _update_shards(session, stmt, meta, bound, rel, bound_assign,
-                               direct, deletes, pending,
-                               codec, level, chunk_rows)
-    except Exception:
-        session.store.discard_pending(stmt.table, pending)
-        raise
-    if deletes or pending:
-        session.store.apply_dml(stmt.table, deletes, pending)
+    shards = _target_shards(session, stmt.table, rel, bound.conjuncts)
+    with session._dml_locks(stmt.table, [s.shard_id for s in shards]):
+        try:
+            count = _update_shards(session, stmt, meta, bound, rel,
+                                   bound_assign, direct, deletes, pending,
+                                   codec, level, chunk_rows, shards)
+        except Exception:
+            session.store.discard_pending(stmt.table, pending)
+            raise
+        if deletes or pending:
+            session._apply_dml(stmt.table, deletes, pending)
     return _result(count, "UPDATE")
 
 
 def _update_shards(session, stmt, meta, bound, rel, bound_assign, direct,
-                   deletes, pending, codec, level, chunk_rows) -> int:
+                   deletes, pending, codec, level, chunk_rows, shards) -> int:
     count = 0
-    for shard in _target_shards(session, stmt.table, rel, bound.conjuncts):
+    for shard in shards:
         new_vals: dict[str, list[np.ndarray]] = {c: [] for c in
                                                  meta.schema.names}
         new_valid: dict[str, list[np.ndarray]] = {c: [] for c in
@@ -415,26 +419,28 @@ def execute_merge(session, stmt: ast.Merge):
     all_deletes: dict[int, dict[str, np.ndarray]] = {}
     all_pending: list[tuple[int, dict]] = []
 
-    try:
-        n_updated, n_deleted, n_inserted, insert_cols, insert_rows_acc = \
-            _merge_shards(session, stmt, meta, shards, src_shard, src_cols,
-                          src_alias, target_alias, pairs, residual,
-                          all_deletes, all_pending, codec, level, chunk_rows)
-        if insert_rows_acc:
-            # inserts join the same manifest flip as updates/deletes —
-            # the whole MERGE becomes visible atomically or not at all
-            from ..ingest.copy_from import prepare_rows
+    with session._dml_locks(stmt.target, [s.shard_id for s in shards]):
+        try:
+            n_updated, n_deleted, n_inserted, insert_cols, insert_rows_acc = \
+                _merge_shards(session, stmt, meta, shards, src_shard,
+                              src_cols, src_alias, target_alias, pairs,
+                              residual, all_deletes, all_pending,
+                              codec, level, chunk_rows)
+            if insert_rows_acc:
+                # inserts join the same manifest flip as updates/deletes —
+                # the whole MERGE becomes visible atomically or not at all
+                from ..ingest.copy_from import prepare_rows
 
-            _n, ins_pending = prepare_rows(
-                session, stmt.target, list(insert_cols),
-                [list(r) for r in insert_rows_acc], commit=False)
-            all_pending.extend(ins_pending)
-    except Exception:
-        session.store.discard_pending(stmt.target, all_pending)
-        raise
+                _n, ins_pending = prepare_rows(
+                    session, stmt.target, list(insert_cols),
+                    [list(r) for r in insert_rows_acc], commit=False)
+                all_pending.extend(ins_pending)
+        except Exception:
+            session.store.discard_pending(stmt.target, all_pending)
+            raise
 
-    if all_deletes or all_pending:
-        session.store.apply_dml(stmt.target, all_deletes, all_pending)
+        if all_deletes or all_pending:
+            session._apply_dml(stmt.target, all_deletes, all_pending)
     return _result(n_updated + n_deleted + n_inserted, "MERGE")
 
 

@@ -24,6 +24,19 @@ _NP_DTYPE = {
     DataType.STRING: "int32",
 }
 
+# device float policy: SQL double precision evaluates in the session's
+# compute dtype on device (f64 is emulated on TPU — slow, and 64-bit
+# bitcasts don't compile); the host backend keeps exact float64.  The
+# compiler sets this at trace time (PlanCompiler.build).
+DEVICE_FLOAT64 = np.dtype(np.float64)
+
+
+def _dt(e_dtype: DataType, xp):
+    name = _NP_DTYPE[e_dtype]
+    if name == "float64" and xp is not np:
+        return DEVICE_FLOAT64
+    return getattr(np, name)
+
 
 class ColumnSource:
     """What the evaluator reads: column arrays + null masks by cid."""
@@ -47,14 +60,14 @@ def evaluate(e: ir.BExpr, src: ColumnSource, xp):
             raise ExecutionError("unfolded interval constant reached executor")
         if e.value is None:
             # typed NULL: zeros + all-null mask (broadcast by consumers)
-            return (xp.zeros((), dtype=getattr(np, _NP_DTYPE[e.dtype])),
+            return (xp.zeros((), dtype=_dt(e.dtype, xp)),
                     xp.ones((), dtype=bool))
-        return (xp.asarray(e.value, dtype=getattr(np, _NP_DTYPE[e.dtype])),
+        return (xp.asarray(e.value, dtype=_dt(e.dtype, xp)),
                 None)
     if isinstance(e, ir.BArith):
         lv, ln = evaluate(e.left, src, xp)
         rv, rn = evaluate(e.right, src, xp)
-        dt = getattr(np, _NP_DTYPE[e.dtype])
+        dt = _dt(e.dtype, xp)
         lv = lv.astype(dt)
         rv = rv.astype(dt)
         if e.op == "+":
@@ -132,7 +145,7 @@ def evaluate(e: ir.BExpr, src: ColumnSource, xp):
             out = ~out
         return out, nmask
     if isinstance(e, ir.BCase):
-        dt = getattr(np, _NP_DTYPE[e.dtype])
+        dt = _dt(e.dtype, xp)
         if e.else_result is not None:
             out, nmask = evaluate(e.else_result, src, xp)
             out = xp.asarray(out, dtype=dt)
@@ -152,7 +165,7 @@ def evaluate(e: ir.BExpr, src: ColumnSource, xp):
         return out, nmask
     if isinstance(e, ir.BCast):
         v, nmask = evaluate(e.operand, src, xp)
-        return v.astype(getattr(np, _NP_DTYPE[e.dtype])), nmask
+        return v.astype(_dt(e.dtype, xp)), nmask
     if isinstance(e, ir.BExtract):
         v, nmask = evaluate(e.operand, src, xp)
         return _extract_date_part(v, e.part, xp), nmask

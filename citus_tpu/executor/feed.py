@@ -43,13 +43,58 @@ def walk_plan(node: PlanNode):
 
 
 def build_feeds(plan: QueryPlan, catalog: Catalog, store: TableStore,
-                mesh: Mesh, compute_dtype=np.float32) -> dict[int, FeedSpec]:
+                mesh: Mesh, compute_dtype=np.float32,
+                cache=None) -> dict[int, FeedSpec]:
     feeds: dict[int, FeedSpec] = {}
     for node in walk_plan(plan.root):
         if isinstance(node, ScanNode):
-            feeds[id(node)] = _feed_scan(node, catalog, store, mesh,
-                                         plan.n_devices, compute_dtype)
+            feeds[id(node)] = _feed_scan_cached(node, catalog, store, mesh,
+                                                plan.n_devices, compute_dtype,
+                                                cache)
     return feeds
+
+
+def _overlay_touches(store: TableStore, table: str) -> bool:
+    ov = store.overlay
+    if ov is None:
+        return False
+    return (any(t == table for t, _ in ov.records)
+            or any(t == table for t, _, _ in ov.deletes))
+
+
+def _feed_scan_cached(node: ScanNode, catalog: Catalog, store: TableStore,
+                      mesh: Mesh, n_dev: int, compute_dtype,
+                      cache) -> FeedSpec:
+    """Device-feed cache wrapper: HBM-resident table arrays keyed on
+    (table, columns, pruning, placement, data version) — see
+    executor/cache.py.  Open-transaction overlays bypass the cache (their
+    visibility is session-private and changes mid-transaction)."""
+    table = node.rel.table
+    if cache is None or _overlay_touches(store, table):
+        return _feed_scan(node, catalog, store, mesh, n_dev, compute_dtype)
+    shards = catalog.table_shards(table)
+    placement_sig = tuple(
+        (s.shard_id, catalog.active_placement(s.shard_id).node_id)
+        for s in shards)
+    key = (table, store.data_version(table), tuple(node.columns),
+           None if node.pruned_shards is None else tuple(node.pruned_shards),
+           n_dev, str(np.dtype(compute_dtype)), placement_sig)
+    entry = cache.get(key)
+    if entry is None:
+        spec = _feed_scan(node, catalog, store, mesh, n_dev, compute_dtype)
+        from .cache import CachedFeed
+
+        nbytes = sum(int(np.dtype(a.dtype).itemsize * a.size)
+                     for a in list(spec.arrays.values())
+                     + list(spec.nulls.values()) + [spec.valid])
+        entry = CachedFeed(sharded=spec.sharded, arrays=spec.arrays,
+                           nulls=spec.nulls, valid=spec.valid,
+                           capacity=spec.capacity, nbytes=nbytes)
+        cache.put(key, entry)
+        return spec
+    return FeedSpec(node=node, sharded=entry.sharded, arrays=entry.arrays,
+                    nulls=entry.nulls, valid=entry.valid,
+                    capacity=entry.capacity)
 
 
 def _feed_scan(node: ScanNode, catalog: Catalog, store: TableStore,

@@ -56,6 +56,38 @@ class Scope:
         return self._cols[slot]
 
 
+import contextlib
+import threading
+
+_guard_state = threading.local()
+
+
+@contextlib.contextmanager
+def _guarded():
+    """Marks evaluation of a CASE branch result: per-row guards may
+    exclude the rows whose divisors are zero, so raising is wrong."""
+    prev = getattr(_guard_state, "depth", 0)
+    _guard_state.depth = prev + 1
+    try:
+        yield
+    finally:
+        _guard_state.depth = prev
+
+
+def _check_divisor(rv, rn) -> None:
+    """PostgreSQL raises division_by_zero for any non-NULL zero divisor
+    (NULL divisors pass through as NULL).  Suppressed inside CASE branch
+    results (see _guarded) where the old masked-NaN behavior applies."""
+    if getattr(_guard_state, "depth", 0):
+        return
+    rv = np.asarray(rv)
+    zero = rv == 0
+    if rn is not None:
+        zero = zero & ~np.broadcast_to(rn, rv.shape)
+    if np.any(zero):
+        raise ExecutionError("division by zero")
+
+
 def _null_or(a, b):
     if a is None:
         return b
@@ -128,6 +160,7 @@ def eval_expr(e: ast.Expr, scope: Scope):
             elif op == "*":
                 out = lv * rv
             elif op == "/":
+                _check_divisor(rv, rn)
                 if np.issubdtype(np.result_type(lv, rv), np.integer):
                     rv_safe = np.where(rv == 0, 1, rv)
                     q = lv // rv_safe
@@ -136,6 +169,7 @@ def eval_expr(e: ast.Expr, scope: Scope):
                 else:
                     out = lv / np.where(rv == 0, np.nan, rv)
             else:
+                _check_divisor(rv, rn)
                 out = np.fmod(lv, np.where(rv == 0, 1, rv))
             return out, _null_or(ln, rn)
         raise ExecutionError(f"bad binary op {e.op}")
@@ -176,8 +210,12 @@ def eval_expr(e: ast.Expr, scope: Scope):
             out = ~out
         return out, null_out
     if isinstance(e, ast.CaseWhen):
+        # branch results evaluate vectorized over ALL rows, so a zero
+        # divisor in a branch the guard excludes must not raise — PG
+        # guarantees CASE short-circuits per row (_check_divisor defers)
         if e.else_result is not None:
-            out, nm = eval_expr(e.else_result, scope)
+            with _guarded():
+                out, nm = eval_expr(e.else_result, scope)
             out = np.asarray(out)
         else:
             out, nm = np.zeros((), dtype=np.int64), np.ones((), dtype=bool)
@@ -186,7 +224,8 @@ def eval_expr(e: ast.Expr, scope: Scope):
             take = np.asarray(cv, dtype=bool)
             if cn is not None:
                 take = take & ~cn
-            rv, rn = eval_expr(res, scope)
+            with _guarded():
+                rv, rn = eval_expr(res, scope)
             out = np.where(take, rv, out)
             new_null = (np.zeros(np.shape(rv), dtype=bool) if rn is None
                         else rn)

@@ -5,8 +5,7 @@ multi_copy.c:315 CitusSendTupleToPlacements): instead of a per-tuple
 parse→hash→route loop feeding per-shard COPY connections, rows batch into
 numpy columns, route vectorized by hash token, and append as per-shard
 stripes; the whole batch becomes visible atomically via commit_pending
-(the COPY-transaction analogue).  A C++ parser (native/) accelerates the
-text→columns step when built.
+(the COPY-transaction analogue).
 """
 
 from __future__ import annotations
@@ -89,7 +88,14 @@ def _ingest_batch(session, table: str, columns: list[str],
     meta = session.catalog.table(table)
     n = len(batch[0])
     if n == 0:
-        return 0
+        return 0, []
+    # inside an open transaction, commits stage into the overlay instead
+    # (visible to this session, durable at COMMIT)
+    in_txn = getattr(session, "txn_manager", None) is not None and \
+        session.txn_manager.current is not None
+    stage_txn = commit and in_txn
+    if stage_txn:
+        commit = False
     typed: dict[str, np.ndarray] = {}
     validity: dict[str, np.ndarray] = {}
     for name, cells in zip(columns, batch):
@@ -117,17 +123,23 @@ def _ingest_batch(session, table: str, columns: list[str],
                                  typed[dist_col])
         shard_idx = shard_index_for_token(tokens, len(shards))
         pending = []
-        for i, s in enumerate(shards):
-            mask = shard_idx == i
-            cnt = int(mask.sum())
-            if cnt == 0:
-                continue
-            sub = {c: typed[c][mask] for c in typed}
-            subv = {c: validity[c][mask] for c in validity}
-            rec = session.store.append_stripe(
-                table, s.shard_id, sub, subv, codec=codec, level=level,
-                chunk_rows=chunk_rows, commit=False)
-            pending.append((s.shard_id, rec))
+        try:
+            for i, s in enumerate(shards):
+                mask = shard_idx == i
+                cnt = int(mask.sum())
+                if cnt == 0:
+                    continue
+                sub = {c: typed[c][mask] for c in typed}
+                subv = {c: validity[c][mask] for c in validity}
+                rec = session.store.append_stripe(
+                    table, s.shard_id, sub, subv, codec=codec, level=level,
+                    chunk_rows=chunk_rows, commit=False)
+                pending.append((s.shard_id, rec))
+        except Exception:
+            # a failed later shard must not leak the earlier shards'
+            # already-written (invisible) stripe files
+            session.store.discard_pending(table, pending)
+            raise
         if commit:
             session.store.commit_pending(table, pending)
             pending = []
@@ -137,6 +149,9 @@ def _ingest_batch(session, table: str, columns: list[str],
             table, shard.shard_id, typed, validity, codec=codec,
             level=level, chunk_rows=chunk_rows, commit=commit)
         pending = [] if commit else [(shard.shard_id, rec)]
+    if stage_txn:
+        session.txn_manager.current.stage_dml(table, {}, pending)
+        pending = []
     stats = getattr(session, "stats", None)
     if stats is not None:
         from ..stats.counters import ROWS_INGESTED

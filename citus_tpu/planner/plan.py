@@ -99,6 +99,18 @@ class AggregateNode(PlanNode):
     input: PlanNode
     group_keys: list[tuple[ir.BExpr, str]]      # (expr, out cid)
     aggs: list[tuple[ir.BAgg, str]]             # (agg, out cid)
+    # estimated distinct group count (0 = unknown); sizes the static
+    # aggregate-output/shuffle buffers so low-cardinality GROUP BYs don't
+    # allocate (and transfer) input-sized results
+    est_groups: int = 0
+    # dense-grid aggregation: when every group key is a bare column with a
+    # known small value range, keys map to a dense slot id and aggregation
+    # is ONE unsorted segment reduction over [total_slots] — no sort, and
+    # the cross-device combine is psum/pmin/pmax instead of an all_to_all
+    # shuffle (the TPU-native fast path; the sort path remains for
+    # high-cardinality keys).  Entries: (base, extent, has_null) per key.
+    dense_keys: Optional[tuple[tuple[int, int, bool], ...]] = None
+    dense_total: int = 0
 
 
 @dataclass
@@ -112,11 +124,23 @@ class ProjectNode(PlanNode):
 # --------------------------------------------------------------------------
 
 class StatsProvider:
-    """Row counts for capacity planning (shard_size/row metadata analogue,
-    metadata/metadata_utility.c)."""
+    """Row counts + column cardinalities for capacity planning
+    (shard_size/row metadata analogue, metadata/metadata_utility.c; ndv
+    plays the role of pg_statistic's n_distinct for the estimator)."""
 
     def table_rows(self, table: str) -> int:  # pragma: no cover
         raise NotImplementedError
+
+    def column_ndv(self, table: str, column: str,
+                   dtype) -> int | None:  # pragma: no cover
+        """Distinct-value estimate for a column; None = unknown."""
+        return None
+
+    def column_extent(self, table: str, column: str,
+                      dtype) -> tuple[int, int] | None:  # pragma: no cover
+        """(base, extent) of the column's value range — dictionary codes
+        for strings, manifest min/max for ints/dates; None = unknown."""
+        return None
 
 
 @dataclass
@@ -535,6 +559,8 @@ class DistributedPlanner:
         node = AggregateNode(
             combine="", input=input_node,
             group_keys=group_keys, aggs=aggs)
+        node.est_groups = self._estimate_groups(group_keys, input_node)
+        self._plan_dense_grid(node)
         gk_cids = set()
         for g, _ in group_keys:
             if isinstance(g, ir.BCol):
@@ -555,6 +581,64 @@ class DistributedPlanner:
         for a, cid in aggs:
             node.out_columns[cid] = a.dtype
         return node, host_select, having, host_order
+
+    DENSE_GROUP_LIMIT = 8192
+
+    def _plan_dense_grid(self, node: AggregateNode) -> None:
+        """Annotate the aggregate with dense-slot metadata when every
+        group key is a bare column over a known small value range."""
+        if not node.group_keys:
+            return
+        specs = []
+        total = 1
+        for g, _cid in node.group_keys:
+            if not isinstance(g, ir.BCol) or not g.table:
+                return
+            ext = self.stats.column_extent(g.table, g.column, g.dtype)
+            if ext is None or ext[1] <= 0:
+                return
+            base, extent = ext
+            has_null = self._column_nullable(g)
+            specs.append((int(base), int(extent), has_null))
+            total *= extent + (1 if has_null else 0)
+            if total > self.DENSE_GROUP_LIMIT:
+                return
+        node.dense_keys = tuple(specs)
+        node.dense_total = total
+
+    def _column_nullable(self, col: ir.BCol) -> bool:
+        try:
+            meta = self.catalog.table(col.table)
+            return meta.schema.column(col.column).nullable
+        except Exception:
+            return True
+
+    def _estimate_groups(self, group_keys, input_node: PlanNode) -> int:
+        """Product of per-key ndv estimates, clipped to input rows
+        (0 = some key has no estimate).  Mirrors the role of the
+        reference's worker-hash-size estimation in the logical optimizer."""
+        if not group_keys:
+            return 1
+        est = 1
+        for g, _cid in group_keys:
+            ndv = None
+            if isinstance(g, ir.BCol) and g.table:
+                ndv = self.stats.column_ndv(g.table, g.column, g.dtype)
+            elif isinstance(g, ir.BExtract) and \
+                    isinstance(g.operand, ir.BCol) and g.operand.table:
+                days = self.stats.column_ndv(g.operand.table,
+                                             g.operand.column,
+                                             g.operand.dtype)
+                if days is not None:
+                    ndv = {"year": days // 365, "month": 12,
+                           "day": 31}.get(g.part)
+                    ndv = max(1, ndv) if ndv is not None else None
+            if ndv is None or ndv <= 0:
+                return 0
+            est *= ndv
+            if est > input_node.est_rows:
+                return input_node.est_rows
+        return max(1, est)
 
     def _plan_projection(self, q: BoundQuery, input_node: PlanNode,
                          decode: dict):

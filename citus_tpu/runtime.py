@@ -34,6 +34,19 @@ def ensure_jax_configured(platform: str | None = None,
     jax.config.update("jax_enable_x64", True)
     if platform is not None:
         jax.config.update("jax_platforms", platform)
+    if not _configured:
+        # persistent XLA executable cache: repeated plan shapes skip the
+        # (tens of seconds, on remote TPUs) cold compile across processes
+        cache_dir = os.environ.get(
+            "CITUS_TPU_COMPILE_CACHE",
+            os.path.join(os.path.expanduser("~"), ".cache",
+                         "citus_tpu_xla"))
+        try:
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                              1.0)
+        except Exception:
+            pass  # older jax without persistent-cache config
     _configured = True
 
 

@@ -18,6 +18,7 @@ fold into literals, then the rewritten outer query plans normally.
 
 from __future__ import annotations
 
+import contextlib
 import os
 import tempfile
 from dataclasses import replace as dc_replace
@@ -56,6 +57,26 @@ class _StoreStats(StatsProvider):
     def table_rows(self, table: str) -> int:
         return self.store.table_row_count(table)
 
+    def column_ndv(self, table: str, column: str, dtype) -> int | None:
+        ext = self.column_extent(table, column, dtype)
+        return None if ext is None else ext[1]
+
+    def column_extent(self, table: str, column: str,
+                      dtype) -> tuple[int, int] | None:
+        if dtype == DataType.STRING:
+            try:
+                d = self.store.dictionary(table, column)
+            except Exception:
+                return None
+            return (0, len(d)) if len(d) else None
+        if dtype in (DataType.INT32, DataType.INT64, DataType.DATE,
+                     DataType.BOOL):
+            rng = self.store.column_range(table, column)
+            if rng is None:
+                return None
+            return int(rng[0]), int(rng[1] - rng[0]) + 1
+        return None
+
 
 class _StoreDicts(DictProvider):
     def __init__(self, store: TableStore):
@@ -91,6 +112,16 @@ class Session:
         self.stats = SessionStats()
         self.executor = Executor(self.catalog, self.store, self.settings,
                                  self.mesh)
+        # transaction coordinator + shared lock table; interrupted 2PCs
+        # from a previous process roll forward/back NOW, before any read
+        # (the maintenance-daemon recovery pass at backend start;
+        # ref: transaction/transaction_recovery.c)
+        from .transaction.locks import lock_manager_for
+        from .transaction.manager import TransactionManager
+
+        self.txn_manager = TransactionManager(self.store, self.data_dir)
+        self.locks = lock_manager_for(self.data_dir)
+        self.txn_manager.recover()
 
     # -- public API --------------------------------------------------------
     def execute(self, sql: str):
@@ -191,6 +222,8 @@ class Session:
             return copy_from(self, stmt)
         if isinstance(stmt, ast.Explain):
             return self._execute_explain(stmt)
+        if isinstance(stmt, ast.TransactionStmt):
+            return self._execute_transaction_stmt(stmt)
         if isinstance(stmt, ast.SetVariable):
             self.settings.set(stmt.name, stmt.value)
             return None
@@ -321,6 +354,62 @@ class Session:
         self._save_catalog()
         return None
 
+    # -- transactions ------------------------------------------------------
+    def _execute_transaction_stmt(self, stmt: ast.TransactionStmt):
+        if stmt.kind == "begin":
+            self.txn_manager.begin()
+            return None
+        txn = self.txn_manager.current
+        txid = txn.txid if txn is not None else None
+        try:
+            if stmt.kind == "commit":
+                self.txn_manager.commit()
+            else:
+                self.txn_manager.rollback()
+        finally:
+            if txid is not None:
+                self.locks.release_all(txid)
+        return None
+
+    def _apply_dml(self, table: str, deletes, pending) -> None:
+        """Route a DML effect set: stage into the open transaction
+        (visible via the read overlay, durable at COMMIT) or apply
+        immediately in autocommit."""
+        txn = self.txn_manager.current
+        if txn is not None:
+            txn.stage_dml(table, deletes, list(pending))
+        else:
+            self.store.apply_dml(table, deletes, list(pending))
+
+    @contextlib.contextmanager
+    def _dml_locks(self, table: str, shard_ids):
+        """Exclusive (table, shard) locks around a DML read-modify-apply
+        window (AcquireExecutorShardLocksForExecution analogue,
+        executor/distributed_execution_locks.c).  Transaction locks are
+        held to COMMIT/ROLLBACK (2PL); autocommit locks release at
+        statement end.  The deadlock victim's transaction rolls back
+        automatically, like the reference canceling the youngest backend."""
+        from .transaction.clock import global_clock
+        from .transaction.locks import DeadlockDetectedError
+
+        txn = self.txn_manager.current
+        txid = txn.txid if txn is not None else global_clock.now()
+        try:
+            for sid in sorted(shard_ids):
+                self.locks.acquire(txid, (table, sid))
+            # see the latest committed state from sessions sharing this
+            # data_dir (manifest cache may predate the lock wait)
+            self.store.refresh(table)
+            yield
+        except DeadlockDetectedError:
+            if txn is not None and self.txn_manager.current is txn:
+                self.txn_manager.rollback()
+                self.locks.release_all(txid)
+            raise
+        finally:
+            if txn is None:
+                self.locks.release_all(txid)
+
     # -- DML ---------------------------------------------------------------
     def _execute_insert_values(self, stmt: ast.InsertValues):
         from .ingest.copy_from import insert_rows
@@ -345,15 +434,24 @@ class Session:
         return insert_rows(self, stmt.table, list(columns), rows)
 
     def _execute_insert_select(self, stmt: ast.InsertSelect):
-        # pull-to-coordinator mode (the reference's third INSERT..SELECT
-        # mode); co-located pushdown is a planned optimization
-        from .ingest.copy_from import insert_rows
+        """Array-path INSERT..SELECT (colocated pushdown / repartition
+        modes, executor/insert_select.py); falls back to the row-based
+        pull-to-coordinator mode only for shapes the raw path rejects."""
+        from .executor.insert_select import execute_insert_select
 
-        result = self._execute_select(stmt.query)
-        meta = self.catalog.table(stmt.table)
-        columns = list(stmt.columns or meta.schema.names)
-        rows = [list(r) for r in result.rows()]
-        return insert_rows(self, stmt.table, columns, rows)
+        try:
+            result, _mode = execute_insert_select(self, stmt)
+            return result
+        except (PlanningError, UnsupportedQueryError):
+            from .ingest.copy_from import insert_rows
+            from .stats import counters as sc
+
+            result = self._execute_select(stmt.query)
+            meta = self.catalog.table(stmt.table)
+            columns = list(stmt.columns or meta.schema.names)
+            rows = [list(r) for r in result.rows()]
+            self.stats.counters.increment(sc.INSERT_SELECT_PULL)
+            return insert_rows(self, stmt.table, columns, rows)
 
     def _execute_dml(self, stmt):
         """UPDATE / DELETE / MERGE — router-planned modify commands

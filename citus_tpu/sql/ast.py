@@ -458,6 +458,11 @@ class Explain(Statement):
 
 
 @dataclass(frozen=True)
+class TransactionStmt(Statement):
+    kind: str  # begin | commit | rollback
+
+
+@dataclass(frozen=True)
 class SetVariable(Statement):
     name: str
     value: object

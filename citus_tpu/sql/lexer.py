@@ -18,6 +18,7 @@ cross on create table drop insert into values copy with delimiter header format
 csv text exists interval date cast extract substring for if asc desc nulls
 first last set show explain analyze verbose union all true false using
 update delete merge matched do nothing returning
+begin commit rollback abort transaction work start
 """.split())
 
 # multi-char operators first (longest match)

@@ -118,7 +118,24 @@ class Parser:
             return self.parse_set()
         if self.at_keyword("show"):
             return self.parse_show()
+        if self.at_keyword("begin", "start", "commit", "rollback", "abort",
+                           "end"):
+            return self.parse_transaction()
         self.error("expected a statement")
+
+    def parse_transaction(self) -> ast.TransactionStmt:
+        if self.accept_keyword("begin"):
+            self.accept_keyword("transaction") or self.accept_keyword("work")
+            return ast.TransactionStmt("begin")
+        if self.accept_keyword("start"):
+            self.expect_keyword("transaction")
+            return ast.TransactionStmt("begin")
+        if self.accept_keyword("commit") or self.accept_keyword("end"):
+            self.accept_keyword("transaction") or self.accept_keyword("work")
+            return ast.TransactionStmt("commit")
+        self.accept_keyword("rollback") or self.expect_keyword("abort")
+        self.accept_keyword("transaction") or self.accept_keyword("work")
+        return ast.TransactionStmt("rollback")
 
     # -- SELECT ------------------------------------------------------------
     def parse_select(self) -> ast.Select:

@@ -28,12 +28,16 @@ DML_MERGE = "dml_merge_count"
 DDL_COMMANDS = "ddl_commands"
 CAPACITY_RETRIES = "capacity_retries"
 DEVICE_ROWS_SCANNED = "device_rows_scanned"
+INSERT_SELECT_PUSHDOWN = "insert_select_pushdown"
+INSERT_SELECT_REPARTITION = "insert_select_repartition"
+INSERT_SELECT_PULL = "insert_select_pull"
 
 ALL_COUNTERS = [
     QUERIES_SINGLE_SHARD, QUERIES_MULTI_SHARD, QUERIES_REPARTITION,
     SUBPLANS_EXECUTED, ROWS_INGESTED, ROWS_RETURNED,
     DML_UPDATE, DML_DELETE, DML_MERGE, DDL_COMMANDS,
     CAPACITY_RETRIES, DEVICE_ROWS_SCANNED,
+    INSERT_SELECT_PUSHDOWN, INSERT_SELECT_REPARTITION, INSERT_SELECT_PULL,
 ]
 
 

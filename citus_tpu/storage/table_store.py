@@ -31,6 +31,35 @@ from .dictionary import Dictionary
 from .format import StripeReader, write_stripe
 
 
+def _column_stats(columns: dict[str, np.ndarray],
+                  validity: dict[str, np.ndarray] | None) -> dict:
+    """Per-column [min, max] over non-NULL values (JSON-safe scalars)."""
+    out = {}
+    for name, arr in columns.items():
+        if arr.dtype == object or arr.size == 0:
+            out[name] = [None, None]
+            continue
+        v = arr
+        if validity is not None and name in validity:
+            v = arr[validity[name]]
+        if v.size == 0:
+            out[name] = [None, None]
+        elif np.issubdtype(v.dtype, np.floating):
+            out[name] = [float(v.min()), float(v.max())]
+        else:
+            out[name] = [int(v.min()), int(v.max())]
+    return out
+
+
+# Process-wide per-(data_dir, table) manifest write locks: sessions sharing
+# a data_dir each cache manifests, so every manifest read-modify-write must
+# serialize AND re-read disk state first, or one session's save can clobber
+# another's committed records (the lost-update the reference prevents with
+# catalog-table row locking).
+_manifest_write_locks: dict[tuple[str, str], threading.Lock] = {}
+_mwl_mu = threading.Lock()
+
+
 class TableStore:
     """Host-side storage manager for all tables under one data directory."""
 
@@ -40,6 +69,10 @@ class TableStore:
         self._lock = threading.RLock()
         self._manifests: dict[str, dict] = {}
         self._dicts: dict[tuple[str, str], Dictionary] = {}
+        # per-table data version: bumped on every visible mutation; the
+        # executor's device-feed cache keys on it (the metadata-cache
+        # invalidation analogue, metadata/metadata_cache.c:287)
+        self._data_versions: dict[str, int] = {}
         # read-your-writes overlay, set by an open transaction
         # (transaction.manager.Transaction): staged-but-uncommitted stripe
         # records and deletion masks folded into every read
@@ -72,12 +105,42 @@ class TableStore:
         os.makedirs(self.table_dir(table), exist_ok=True)
         atomic_write_json(self._manifest_path(table), self._manifests[table])
 
+    def _write_lock(self, table: str) -> threading.Lock:
+        key = (os.path.abspath(self.data_dir), table)
+        with _mwl_mu:
+            if key not in _manifest_write_locks:
+                _manifest_write_locks[key] = threading.Lock()
+            return _manifest_write_locks[key]
+
+    def _reload_manifest_locked(self, table: str) -> dict:
+        """Drop the cached manifest and re-read disk (caller holds
+        self._lock AND the table write lock)."""
+        self._manifests.pop(table, None)
+        return self.manifest(table)
+
+    def data_version(self, table: str) -> int:
+        with self._lock:
+            return self._data_versions.get(table, 0)
+
+    def refresh(self, table: str) -> None:
+        """Drop the cached manifest so the next read reloads from disk —
+        used after lock acquisition so a session sharing this data_dir
+        sees the lock winner's committed state."""
+        with self._lock:
+            self._manifests.pop(table, None)
+            self.bump_data_version(table)
+
+    def bump_data_version(self, table: str) -> None:
+        with self._lock:
+            self._data_versions[table] = self._data_versions.get(table, 0) + 1
+
     def drop_table_storage(self, table: str) -> None:
         import shutil
 
         with self._lock:
             self._manifests.pop(table, None)
             self._dicts = {k: v for k, v in self._dicts.items() if k[0] != table}
+            self.bump_data_version(table)
             if os.path.exists(self.table_dir(table)):
                 shutil.rmtree(self.table_dir(table))
 
@@ -111,10 +174,11 @@ class TableStore:
         Returns the pending-stripe record."""
         meta = self.catalog.table(table)
         schema_cols = [(c.name, c.dtype) for c in meta.schema.columns]
-        with self._lock:
+        with self._write_lock(table), self._lock:
             # Persist the bumped counter BEFORE writing the file so a crash +
-            # reopen can never re-allocate (and overwrite) this stripe number.
-            man = self.manifest(table)
+            # reopen can never re-allocate (and overwrite) this stripe
+            # number; reload first so two sessions can't allocate the same.
+            man = self._reload_manifest_locked(table)
             stripe_no = man["next_stripe"]
             man["next_stripe"] = stripe_no + 1
             self._save_manifest(table)
@@ -125,7 +189,8 @@ class TableStore:
         footer = write_stripe(path, schema_cols, columns, validity,
                               codec=codec, level=level, chunk_rows=chunk_rows)
         record = {"file": fname, "rows": footer["row_count"],
-                  "bytes": os.path.getsize(path)}
+                  "bytes": os.path.getsize(path),
+                  "stats": _column_stats(columns, validity)}
         if commit:
             self.commit_pending(table, [(shard_id, record)])
         return record
@@ -137,14 +202,15 @@ class TableStore:
         Dictionaries are persisted first so a committed STRING stripe can
         never reference codes missing from the on-disk dictionary (the
         dictionary is append-only, so over-persisting is harmless)."""
-        with self._lock:
+        with self._write_lock(table), self._lock:
             self.save_dictionaries(table)
-            man = self.manifest(table)
+            man = self._reload_manifest_locked(table)
             for shard_id, record in pending:
                 man["shards"].setdefault(str(shard_id), []).append(record)
                 stripe_no = int(record["file"].split("_")[1].split(".")[0])
                 man["next_stripe"] = max(man["next_stripe"], stripe_no + 1)
             self._save_manifest(table)
+            self.bump_data_version(table)
 
     # -- DML (deletion bitmaps) -------------------------------------------
     # The reference's columnar engine is append-only (columnar/README.md:
@@ -193,9 +259,9 @@ class TableStore:
         visible by a single manifest write.  Delete-mask files are
         versioned, never overwritten in place, so a crash before the
         manifest flip leaves only orphan files."""
-        with self._lock:
+        with self._write_lock(table), self._lock:
             self.save_dictionaries(table)
-            man = self.manifest(table)
+            man = self._reload_manifest_locked(table)
             stale: list[str] = []
             # pending stripes first so a staged delete may target a stripe
             # committed by this very call (transactional UPDATE-after-INSERT)
@@ -235,6 +301,7 @@ class TableStore:
                     rec["del_version"] = version
                     rec["live_rows"] = int((~combined).sum())
             self._save_manifest(table)
+            self.bump_data_version(table)
             for path in stale:
                 try:
                     os.unlink(path)
@@ -298,6 +365,36 @@ class TableStore:
     def shard_size_bytes(self, table: str, shard_id: int) -> int:
         man = self.manifest(table)
         return sum(r["bytes"] for r in man["shards"].get(str(shard_id), []))
+
+    def column_range(self, table: str,
+                     column: str) -> tuple[float, float] | None:
+        """Table-wide (min, max) for a numeric/date column from manifest
+        stripe stats (the per-stripe skip-node rollup the planner's
+        cardinality estimation reads; ref: columnar chunk skip nodes,
+        columnar/columnar_metadata.c).  None when no stripe carries stats
+        (pre-stats files) or the column is all-NULL."""
+        man = self.manifest(table)
+        rec_lists = list(man["shards"].values())
+        if self.overlay is not None:
+            # staged-but-uncommitted stripes are visible to this session's
+            # scans, so their value ranges must widen the extent too —
+            # otherwise dense-grid aggregation clips new keys into the
+            # boundary group
+            rec_lists.extend(recs for (t, _sid), recs
+                             in self.overlay.records.items() if t == table)
+        lo = hi = None
+        for recs in rec_lists:
+            for r in recs:
+                s = (r.get("stats") or {}).get(column)
+                if s is None:
+                    return None
+                if s[0] is None:
+                    continue
+                lo = s[0] if lo is None else min(lo, s[0])
+                hi = s[1] if hi is None else max(hi, s[1])
+        if lo is None:
+            return None
+        return lo, hi
 
     def table_row_count(self, table: str) -> int:
         man = self.manifest(table)
@@ -370,4 +467,5 @@ class TableStore:
             dman["shards"][str(shard_id)] = [dict(r) for r in records]
             dman["next_stripe"] = max(dman["next_stripe"], man["next_stripe"])
             dest_store._save_manifest(table)
+            dest_store.bump_data_version(table)
         return sum(r.get("live_rows", r["rows"]) for r in records)

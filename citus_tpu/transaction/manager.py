@@ -142,12 +142,19 @@ class TransactionManager:
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, prepare_path)
-        # 2. commit record — the atomic commit point
+        _fsync_dir(tdir)
+        # 2. commit record — the atomic commit point.  The directory fsyncs
+        # make the renames themselves durable (the WAL-durability the
+        # reference gets from the pg_dist_transaction INSERT): without
+        # them a crash could lose the commit record and recovery would
+        # roll back a committed transaction.
         commit_path = os.path.join(tdir, "commit")
         with open(commit_path + ".tmp", "w") as f:
             f.flush()
             os.fsync(f.fileno())
         os.replace(commit_path + ".tmp", commit_path)
+        _fsync_dir(tdir)
+        _fsync_dir(self.log_dir)
         # 3. apply per table (each manifest flip is atomic; replay-safe)
         _apply_effects(self.store, tdir, effects)
         # 4. cleanup
@@ -157,6 +164,14 @@ class TransactionManager:
     def recover(self) -> tuple[int, int]:
         """Finish interrupted transactions; → (committed, discarded)."""
         return recover_transactions(self.store, self.log_dir)
+
+
+def _fsync_dir(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
 
 
 def _apply_effects(store, tdir: str, effects: dict) -> None:
