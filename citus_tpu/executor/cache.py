@@ -58,12 +58,13 @@ def node_fingerprint(node: PlanNode) -> str:
         exprs = [(repr(e), cid) for e, cid in node.exprs]
         return f"P({node_fingerprint(node.input)};{exprs})"
     if isinstance(node, JoinNode):
-        return (f"J({node.strategy};{node.repart_key_idx};"
+        return (f"J({node.strategy};{node.join_type};{node.repart_key_idx};"
                 f"{node_fingerprint(node.left)};"
                 f"{node_fingerprint(node.right)};"
                 f"{[repr(k) for k in node.left_keys]};"
                 f"{[repr(k) for k in node.right_keys]};"
-                f"{node.residual!r};{_dist_sig(node.dist)})")
+                f"{node.residual!r};{node.left_match_filter!r};"
+                f"{node.right_match_filter!r};{_dist_sig(node.dist)})")
     if isinstance(node, AggregateNode):
         groups = [(repr(g), cid) for g, cid in node.group_keys]
         aggs = [(repr(a), cid) for a, cid in node.aggs]
@@ -181,8 +182,12 @@ class FeedCache:
             _, old = self._entries.popitem(last=False)
             self._total_bytes -= old.nbytes
 
-    def invalidate_table(self, table: str) -> None:
-        stale = [k for k in self._entries if k[0] == table]
+    def invalidate_table(self, table: str, keep_version: int | None = None
+                         ) -> None:
+        """Drop entries for `table` (key layout: (table, version, ...));
+        keep_version spares the current version's entries."""
+        stale = [k for k in self._entries
+                 if k[0] == table and k[1] != keep_version]
         for k in stale:
             self._total_bytes -= self._entries.pop(k).nbytes
 

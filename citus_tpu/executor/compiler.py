@@ -204,10 +204,11 @@ class PlanCompiler:
 
         def body(*flat_feeds):
             # trace-time device float policy: SQL float64 evaluates in the
-            # session compute dtype on device (see exprs.DEVICE_FLOAT64)
-            from . import exprs as _exprs
+            # session compute dtype on device (thread-local — tracing runs
+            # on the calling thread)
+            from .exprs import set_device_float64
 
-            _exprs.DEVICE_FLOAT64 = np.dtype(self.compute_dtype)
+            set_device_float64(self.compute_dtype)
             blocks = self._unpack_feeds(flat_feeds)
             self._overflow = jnp.zeros((), dtype=jnp.int64)
             out = self._exec(self.plan.root, blocks)
@@ -327,7 +328,8 @@ class PlanCompiler:
     def _repartition(self, blk: Block, keys, shard_count: int,
                      placement: tuple[int, ...], capacity: int,
                      key_arrays: list | None = None,
-                     valid: jnp.ndarray | None = None) -> Block:
+                     valid: jnp.ndarray | None = None,
+                     keep_null_rows: bool = False) -> Block:
         """pack → all_to_all → flatten: the map+fetch phases fused.
 
         When repartitioning toward a TABLE's sharding (repart_left/right),
@@ -338,6 +340,11 @@ class PlanCompiler:
         """
         if key_arrays is None:
             key_arrays, valid = self._eval_keys(blk, keys)
+            if keep_null_rows:
+                # outer-preserved side: NULL-key rows ride the shuffle
+                # (routed by their zeroed storage value — deterministic;
+                # they match nothing but must still emit null-extended)
+                valid = blk.valid
         if len(key_arrays) == 1:
             token = hash_token_jax(key_arrays[0])
         else:
@@ -379,6 +386,8 @@ class PlanCompiler:
         lblk = self._exec(node.left, feeds)
         rblk = self._exec(node.right, feeds)
 
+        keep_l = node.join_type in ("left", "full")   # probe side preserved
+        keep_r = node.join_type in ("right", "full")  # build side preserved
         if node.strategy in ("local", "broadcast"):
             pass
         elif node.strategy == "repart_right":
@@ -388,28 +397,79 @@ class PlanCompiler:
             rblk = self._repartition(rblk,
                                      [node.right_keys[node.repart_key_idx]],
                                      node.left.dist.shard_count,
-                                     node.left.dist.placement, cap)
+                                     node.left.dist.placement, cap,
+                                     keep_null_rows=keep_r)
         elif node.strategy == "repart_left":
             cap = self.caps.repartition[id(node)]
             lblk = self._repartition(lblk,
                                      [node.left_keys[node.repart_key_idx]],
                                      node.right.dist.shard_count,
-                                     node.right.dist.placement, cap)
+                                     node.right.dist.placement, cap,
+                                     keep_null_rows=keep_l)
         elif node.strategy == "repart_both":
             cap = self.caps.repartition[id(node)]
             identity = tuple(range(self.n_dev))
             lblk = self._repartition(lblk, node.left_keys, self.n_dev,
-                                     identity, cap)
+                                     identity, cap, keep_null_rows=keep_l)
             rblk = self._repartition(rblk, node.right_keys, self.n_dev,
-                                     identity, cap)
+                                     identity, cap, keep_null_rows=keep_r)
         else:
             raise ExecutionError(f"bad join strategy {node.strategy}")
 
-        lkeys, lvalid = self._eval_keys(lblk, node.left_keys)
-        rkeys, rvalid = self._eval_keys(rblk, node.right_keys)
+        lkeys, lmatch = self._eval_keys(lblk, node.left_keys)
+        rkeys, rmatch = self._eval_keys(rblk, node.right_keys)
+        # ON single-side gates: restrict MATCHING without dropping rows
+        if node.left_match_filter is not None:
+            lmatch = lmatch & predicate_mask(node.left_match_filter,
+                                             _src(lblk), jnp)
+        if node.right_match_filter is not None:
+            rmatch = rmatch & predicate_mask(node.right_match_filter,
+                                             _src(rblk), jnp)
         out_cap = self.caps.join_out[id(node)]
-        bidx, pidx, out_valid, overflow = expand_join(
-            rkeys, rvalid, lkeys, lvalid, out_cap)
+
+        if node.join_type == "inner":
+            bidx, pidx, out_valid, overflow = expand_join(
+                rkeys, rmatch, lkeys, lmatch, out_cap)
+            self._overflow = self._overflow + overflow.astype(jnp.int64)
+            cols, nulls = {}, {}
+            for cid, arr in lblk.columns.items():
+                cols[cid] = arr[pidx]
+            for cid, nmask in lblk.nulls.items():
+                nulls[cid] = nmask[pidx]
+            for cid, arr in rblk.columns.items():
+                cols[cid] = arr[bidx]
+            for cid, nmask in rblk.nulls.items():
+                nulls[cid] = nmask[bidx]
+            blk = Block(cols, out_valid, nulls)
+        else:
+            blk = self._exec_outer_expand(node, lblk, rblk, lkeys, lmatch,
+                                          rkeys, rmatch, out_cap)
+        if node.residual is not None:
+            blk = blk.with_filter(predicate_mask(node.residual,
+                                                 _src(blk), jnp))
+        return blk
+
+    def _exec_outer_expand(self, node: JoinNode, lblk: Block, rblk: Block,
+                           lkeys, lmatch, rkeys, rmatch,
+                           out_cap: int) -> Block:
+        """LEFT/RIGHT/FULL pair emission + null extension.
+
+        LEFT: unmatched probe rows emit once with build columns NULL.
+        RIGHT/FULL: unmatched build rows append as a second fixed-size
+        segment with probe columns NULL; a replicated (broadcast) build
+        side combines matched flags across devices with psum and emits
+        its unmatched rows on device 0 only.  Reference semantics:
+        planner/multi_router_planner.c:187 outer-join handling."""
+        from ..ops.join import expand_join_outer
+
+        probe_outer = node.join_type in ("left", "full")
+        build_outer = node.join_type in ("right", "full")
+        replicated_build = build_outer and node.strategy == "broadcast"
+        bidx, pidx, pair_valid, bmissing, unmatched_b, overflow = \
+            expand_join_outer(rkeys, rblk.valid, rmatch,
+                              lkeys, lblk.valid, lmatch, out_cap,
+                              probe_outer, build_outer,
+                              replicated_build, SHARD_AXIS)
         self._overflow = self._overflow + overflow.astype(jnp.int64)
 
         cols, nulls = {}, {}
@@ -419,13 +479,32 @@ class PlanCompiler:
             nulls[cid] = nmask[pidx]
         for cid, arr in rblk.columns.items():
             cols[cid] = arr[bidx]
-        for cid, nmask in rblk.nulls.items():
-            nulls[cid] = nmask[bidx]
-        blk = Block(cols, out_valid, nulls)
-        if node.residual is not None:
-            blk = blk.with_filter(predicate_mask(node.residual,
-                                                 _src(blk), jnp))
-        return blk
+            gathered = rblk.nulls.get(cid)
+            nulls[cid] = (bmissing if gathered is None
+                          else (gathered[bidx] | bmissing))
+        valid = pair_valid
+
+        if build_outer:
+            m = rblk.valid.shape[0]
+            seg_cols, seg_nulls = {}, {}
+            for cid, arr in lblk.columns.items():
+                seg_cols[cid] = jnp.broadcast_to(arr[0], (m,))
+                seg_nulls[cid] = jnp.ones(m, jnp.bool_)
+            for cid, arr in rblk.columns.items():
+                seg_cols[cid] = arr
+                nm = rblk.nulls.get(cid)
+                seg_nulls[cid] = (jnp.zeros(m, jnp.bool_) if nm is None
+                                  else nm)
+            out_cols, out_nulls = {}, {}
+            for cid in cols:
+                out_cols[cid] = jnp.concatenate([cols[cid], seg_cols[cid]])
+                pn = nulls.get(cid)
+                if pn is None:
+                    pn = jnp.zeros(pair_valid.shape, jnp.bool_)
+                out_nulls[cid] = jnp.concatenate([pn, seg_nulls[cid]])
+            return Block(out_cols,
+                         jnp.concatenate([valid, unmatched_b]), out_nulls)
+        return Block(cols, valid, nulls)
 
     # -- aggregation ----------------------------------------------------
     def _agg_values(self, node: AggregateNode, blk: Block):
@@ -624,6 +703,10 @@ class PlanCompiler:
             oob = (rebased < 0) | (rebased >= extent)
             if nm is not None:
                 oob = oob & ~nm
+            if nm is not None and not has_null:
+                # runtime NULLs the planner didn't predict: force a retry
+                # path instead of mis-grouping them
+                oob = oob | nm
             self._overflow = self._overflow + \
                 (oob & blk.valid).sum().astype(jnp.int64)
             if has_null and nm is not None:

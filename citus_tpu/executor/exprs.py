@@ -27,14 +27,21 @@ _NP_DTYPE = {
 # device float policy: SQL double precision evaluates in the session's
 # compute dtype on device (f64 is emulated on TPU — slow, and 64-bit
 # bitcasts don't compile); the host backend keeps exact float64.  The
-# compiler sets this at trace time (PlanCompiler.build).
-DEVICE_FLOAT64 = np.dtype(np.float64)
+# compiler sets this at trace time (PlanCompiler.build) — thread-local so
+# sessions tracing concurrently with different compute dtypes don't race.
+import threading
+
+_device_float = threading.local()
+
+
+def set_device_float64(dtype) -> None:
+    _device_float.dtype = np.dtype(dtype)
 
 
 def _dt(e_dtype: DataType, xp):
     name = _NP_DTYPE[e_dtype]
     if name == "float64" and xp is not np:
-        return DEVICE_FLOAT64
+        return getattr(_device_float, "dtype", np.dtype(np.float64))
     return getattr(np, name)
 
 

@@ -81,6 +81,9 @@ def _feed_scan_cached(node: ScanNode, catalog: Catalog, store: TableStore,
            n_dev, str(np.dtype(compute_dtype)), placement_sig)
     entry = cache.get(key)
     if entry is None:
+        # superseded versions of this table can never hit again — free
+        # their HBM before resident-caching the fresh feed
+        cache.invalidate_table(table, keep_version=key[1])
         spec = _feed_scan(node, catalog, store, mesh, n_dev, compute_dtype)
         from .cache import CachedFeed
 
@@ -110,11 +113,13 @@ def _feed_scan(node: ScanNode, catalog: Catalog, store: TableStore,
         per_dev_mask: list[dict[str, list[np.ndarray]]] = [
             {c: [] for c in colnames} for _ in range(n_dev)]
         per_dev_rows = [0] * n_dev
-        for s in shards:
+        from ..planner.plan import table_placement
+
+        placement = table_placement(catalog, rel.table, n_dev)
+        for s, dev in zip(shards, placement):
             if node.pruned_shards is not None and \
                     s.shard_index not in node.pruned_shards:
                 continue
-            dev = (catalog.active_placement(s.shard_id).node_id - 1) % n_dev
             vals, mask, n = store.read_shard(rel.table, s.shard_id, colnames)
             if n == 0:
                 continue

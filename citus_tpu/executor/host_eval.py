@@ -74,16 +74,19 @@ def _guarded():
         _guard_state.depth = prev
 
 
-def _check_divisor(rv, rn) -> None:
+def _check_divisor(rv, rn, ln=None) -> None:
     """PostgreSQL raises division_by_zero for any non-NULL zero divisor
-    (NULL divisors pass through as NULL).  Suppressed inside CASE branch
-    results (see _guarded) where the old masked-NaN behavior applies."""
+    (a NULL on EITHER side short-circuits the strict operator to NULL).
+    Suppressed inside CASE branches (see _guarded) where the old
+    masked-NaN behavior applies."""
     if getattr(_guard_state, "depth", 0):
         return
     rv = np.asarray(rv)
     zero = rv == 0
     if rn is not None:
         zero = zero & ~np.broadcast_to(rn, rv.shape)
+    if ln is not None:
+        zero = zero & ~np.broadcast_to(ln, zero.shape)
     if np.any(zero):
         raise ExecutionError("division by zero")
 
@@ -160,7 +163,7 @@ def eval_expr(e: ast.Expr, scope: Scope):
             elif op == "*":
                 out = lv * rv
             elif op == "/":
-                _check_divisor(rv, rn)
+                _check_divisor(rv, rn, ln)
                 if np.issubdtype(np.result_type(lv, rv), np.integer):
                     rv_safe = np.where(rv == 0, 1, rv)
                     q = lv // rv_safe
@@ -169,7 +172,7 @@ def eval_expr(e: ast.Expr, scope: Scope):
                 else:
                     out = lv / np.where(rv == 0, np.nan, rv)
             else:
-                _check_divisor(rv, rn)
+                _check_divisor(rv, rn, ln)
                 out = np.fmod(lv, np.where(rv == 0, 1, rv))
             return out, _null_or(ln, rn)
         raise ExecutionError(f"bad binary op {e.op}")
@@ -220,7 +223,8 @@ def eval_expr(e: ast.Expr, scope: Scope):
         else:
             out, nm = np.zeros((), dtype=np.int64), np.ones((), dtype=bool)
         for cond, res in reversed(e.whens):
-            cv, cn = eval_expr(cond, scope)
+            with _guarded():
+                cv, cn = eval_expr(cond, scope)
             take = np.asarray(cv, dtype=bool)
             if cn is not None:
                 take = take & ~cn

@@ -49,10 +49,11 @@ def choose_mode(session, plan: QueryPlan, meta,
     root = plan.root
     if root.dist.kind != "hash":
         return "repartition"
+    from ..planner.plan import table_placement
+
     shards = session.catalog.table_shards(meta.name)
-    placement = tuple(
-        (session.catalog.active_placement(s.shard_id).node_id - 1)
-        % session.n_devices for s in shards)
+    placement = table_placement(session.catalog, meta.name,
+                                session.n_devices)
     if root.dist.shard_count != len(shards) or \
             root.dist.placement != placement:
         return "repartition"
@@ -131,15 +132,20 @@ def _target_arrays(session, meta, columns, result):
                 tgt_d = session.store.dictionary(meta.name, tgt_col)
                 if src == (meta.name, tgt_col):
                     codes = arr.astype(np.int32)
+                elif len(src_d) == 0:
+                    codes = np.zeros(n, dtype=np.int32)
                 else:
-                    # vectorized cross-dictionary translation
-                    lut = np.fromiter(
-                        (tgt_d.intern(v) for v in src_d.values),
-                        dtype=np.int32, count=len(src_d))
-                    safe = np.clip(arr.astype(np.int64), 0,
-                                   max(0, len(src_d) - 1))
-                    codes = (lut[safe] if len(src_d)
-                             else np.zeros(n, dtype=np.int32))
+                    # translate only the codes actually present — interning
+                    # the whole source dictionary would permanently bloat
+                    # the target's (dictionaries persist at commit)
+                    safe = np.clip(arr.astype(np.int64), 0, len(src_d) - 1)
+                    present = np.unique(safe[~nmask]) if (~nmask).any() \
+                        else np.empty(0, dtype=np.int64)
+                    lut = np.zeros(len(src_d), dtype=np.int32)
+                    src_vals = src_d.values
+                    for c in present:
+                        lut[c] = tgt_d.intern(src_vals[int(c)])
+                    codes = lut[safe]
                 codes = np.where(nmask, np.int32(NULL_CODE),
                                  codes.astype(np.int32))
                 typed[tgt_col] = codes

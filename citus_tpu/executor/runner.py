@@ -156,13 +156,20 @@ class Executor:
                     repart[id(node)] = _round_cap(
                         int(max(lcap, rcap) * repart_factor))
                     lcap = n_dev * repart[id(node)]
+                    rcap = n_dev * repart[id(node)]
                 if not node.left_keys:
                     # cartesian: output is the full product
                     out = _round_cap(lcap * rcap)
                 else:
                     # probe side is the left/outer side
                     out = _round_cap(int(lcap * join_factor) + 128)
+                    if node.join_type in ("left", "full"):
+                        # unmatched probe rows add up to lcap extra slots
+                        out = _round_cap(out + lcap)
                 join_out[id(node)] = out
+                if node.join_type in ("right", "full"):
+                    # the unmatched-build segment appends rcap fixed slots
+                    out = out + rcap
                 return out
             if isinstance(node, AggregateNode):
                 in_cap = cap_of(node.input)

@@ -138,24 +138,90 @@ def expand_join(build_keys: list[jnp.ndarray], build_valid: jnp.ndarray,
     matches exceed capacity, overflow_count > 0 and the host retries with a
     larger capacity (CapacityOverflowError protocol).
     """
-    sorted_keys, order, n_valid = sort_build_side(build_keys, build_valid)
+    build_idx, probe_idx, out_valid, _missing, overflow = _expand(
+        build_keys, build_valid, probe_keys, probe_valid, probe_valid,
+        capacity, probe_outer=False)
+    return build_idx, probe_idx, out_valid, overflow
+
+
+def _expand(build_keys, build_matchable, probe_keys, probe_valid,
+            probe_matchable, capacity: int, probe_outer: bool):
+    """Pair emission core.
+
+    probe_valid = rows that exist; probe_matchable = rows whose keys may
+    match (valid AND no NULL key — SQL: NULL joins nothing, but a LEFT
+    join still emits the row null-extended).  With probe_outer, valid
+    probe rows with zero matches emit one pair with build_missing=True.
+    """
+    sorted_keys, order, n_valid = sort_build_side(build_keys,
+                                                  build_matchable)
     lo = lower_bound(sorted_keys, n_valid, probe_keys)
     hi = _upper_bound(sorted_keys, n_valid, probe_keys)
-    counts = jnp.where(probe_valid, hi - lo, 0)
-    total = counts.sum()
-    starts = jnp.cumsum(counts) - counts  # exclusive prefix
+    counts = jnp.where(probe_matchable, hi - lo, 0)
+    if probe_outer:
+        emit_counts = jnp.where(probe_valid & (counts == 0), 1, counts)
+    else:
+        emit_counts = counts
+    total = emit_counts.sum()
+    starts = jnp.cumsum(emit_counts) - emit_counts  # exclusive prefix
 
-    # emit: out slot j in [starts[i], starts[i]+counts[i]) maps to probe i,
-    # build sorted index lo[i] + (j - starts[i]).
+    # emit: out slot j in [starts[i], starts[i]+emit_counts[i]) maps to
+    # probe i, build sorted index lo[i] + (j - starts[i]).
     # Recover i per output slot via searchsorted over starts.
-    slots = jnp.arange(capacity, dtype=counts.dtype)
+    slots = jnp.arange(capacity, dtype=emit_counts.dtype)
     probe_idx = jnp.searchsorted(starts, slots, side="right") - 1
     n = probe_keys[0].shape[0]
     probe_idx = jnp.clip(probe_idx, 0, n - 1)
     offset = slots - starts[probe_idx]
-    out_valid = (slots < total) & (offset < counts[probe_idx])
+    out_valid = (slots < total) & (offset < emit_counts[probe_idx])
     m = sorted_keys[0].shape[0]
     sorted_pos = jnp.clip(lo[probe_idx] + offset, 0, m - 1)
     build_idx = order[sorted_pos]
+    build_missing = out_valid & (counts[probe_idx] == 0)
+    build_idx = jnp.where(build_missing, 0, build_idx)
     overflow = jnp.maximum(total - capacity, 0)
-    return build_idx, probe_idx, out_valid, overflow
+    return build_idx, probe_idx, out_valid, build_missing, overflow
+
+
+def expand_join_outer(build_keys: list[jnp.ndarray], build_valid: jnp.ndarray,
+                      build_matchable: jnp.ndarray,
+                      probe_keys: list[jnp.ndarray],
+                      probe_valid: jnp.ndarray,
+                      probe_matchable: jnp.ndarray, capacity: int,
+                      probe_outer: bool, build_outer: bool,
+                      replicated_build: bool = False,
+                      axis_name: str | None = None):
+    """Outer-join pair emission (LEFT/RIGHT/FULL null extension).
+
+    Returns (build_idx [C], probe_idx [C], out_valid [C],
+    build_missing [C], unmatched_build [M], overflow):
+
+    * probe_outer (LEFT): valid probe rows with zero matches emit one pair
+      flagged build_missing — the consumer NULLs the build columns.
+    * build_outer (RIGHT/FULL): unmatched_build marks valid build rows no
+      surviving pair references; the consumer appends them as a second
+      segment with probe columns NULL.  With replicated_build the matched
+      flags combine across devices (psum over `axis_name`) and the extra
+      segment emits on device 0 only, so a broadcast build side doesn't
+      duplicate its unmatched rows once per device.
+    """
+    build_idx, probe_idx, out_valid, build_missing, overflow = _expand(
+        build_keys, build_matchable, probe_keys, probe_valid,
+        probe_matchable, capacity, probe_outer)
+    m = build_keys[0].shape[0]
+    if build_outer:
+        hit = out_valid & ~build_missing
+        matched = jnp.zeros(m, jnp.int32).at[
+            jnp.where(hit, build_idx, 0)].max(hit.astype(jnp.int32))
+        if replicated_build:
+            matched = jax.lax.psum(matched, axis_name) > 0
+        else:
+            matched = matched > 0
+        unmatched_build = build_valid & ~matched
+        if replicated_build:
+            unmatched_build = unmatched_build & (
+                jax.lax.axis_index(axis_name) == 0)
+    else:
+        unmatched_build = jnp.zeros(m, jnp.bool_)
+    return (build_idx, probe_idx, out_valid, build_missing,
+            unmatched_build, overflow)

@@ -43,6 +43,21 @@ class BoundRel:
         return f"{self.rel_index}.{column}"
 
 
+@dataclass(frozen=True)
+class OuterJoinSpec:
+    """One LEFT/RIGHT/FULL join step: the accumulated tree of previously
+    bound rels joins one single relation (`right_rel_index`) with its own
+    ON conjuncts (which must NOT merge into WHERE — null extension happens
+    before WHERE filters).  join_type is relative to (tree, right_rel):
+    'left' preserves the tree, 'right' preserves the single rel, 'full'
+    preserves both."""
+
+    join_type: str
+    tree_rels: frozenset[int]
+    right_rel_index: int
+    on: tuple[ir.BExpr, ...]
+
+
 @dataclass
 class BoundQuery:
     rels: list[BoundRel]
@@ -56,6 +71,10 @@ class BoundQuery:
     offset: int | None
     distinct: bool
     is_aggregate: bool
+    # outer joins, in application order; rel indices whose columns may be
+    # NULL-extended (multi_router_planner.c outer-join handling analogue)
+    outer_joins: list[OuterJoinSpec] = field(default_factory=list)
+    nullable_rels: frozenset[int] = frozenset()
 
 
 class DictProvider:
@@ -99,8 +118,11 @@ class Binder:
                 "CTEs must be planned recursively before binding")
         rels: list[BoundRel] = []
         conjuncts: list[ir.BExpr] = []
+        outer_joins: list[OuterJoinSpec] = []
+        nullable: set[int] = set()
         for item in sel.from_items:
-            self._bind_from_item(item, rels, conjuncts)
+            self._bind_from_item(item, rels, conjuncts, outer_joins,
+                                 nullable)
         if not rels:
             raise PlanningError("SELECT without FROM is not supported")
         scope = _Scope(rels)
@@ -152,11 +174,15 @@ class Binder:
                           group_by=group_by, having=having,
                           order_by=order_by, limit=sel.limit,
                           offset=sel.offset, distinct=sel.distinct,
-                          is_aggregate=is_aggregate)
+                          is_aggregate=is_aggregate,
+                          outer_joins=outer_joins,
+                          nullable_rels=frozenset(nullable))
 
     # -- FROM --------------------------------------------------------------
     def _bind_from_item(self, item: ast.FromItem, rels: list[BoundRel],
-                        conjuncts: list[ir.BExpr]) -> None:
+                        conjuncts: list[ir.BExpr],
+                        outer_joins: list[OuterJoinSpec],
+                        nullable: set[int]) -> None:
         if isinstance(item, ast.TableRef):
             if not self.catalog.has_table(item.name):
                 raise PlanningError(f"table {item.name!r} does not exist")
@@ -171,37 +197,66 @@ class Binder:
             raise PlanningError(
                 "FROM subqueries must be planned recursively before binding")
         if isinstance(item, ast.Join):
-            if item.join_type not in ("inner", "cross"):
+            if item.join_type not in ("inner", "cross", "left", "right",
+                                      "full"):
                 raise PlanningError(
                     f"{item.join_type.upper()} JOIN is not supported yet")
-            self._bind_from_item(item.left, rels, conjuncts)
+            n0 = len(rels)
+            self._bind_from_item(item.left, rels, conjuncts, outer_joins,
+                                 nullable)
             n_before = len(rels)
-            self._bind_from_item(item.right, rels, conjuncts)
+            self._bind_from_item(item.right, rels, conjuncts, outer_joins,
+                                 nullable)
             scope = _Scope(rels)
-            if item.using_cols:
-                right_rel = rels[n_before]
-                left_rels = rels[:n_before]
-                for col in item.using_cols:
-                    lrel = _rel_with_column(left_rels, col)
-                    if lrel is None:
-                        raise PlanningError(
-                            f"USING column {col!r} not found on left side")
-                    if not right_rel.schema.has_column(col):
-                        raise PlanningError(
-                            f"USING column {col!r} not found on right side")
-                    lc = lrel.schema.column(col)
-                    rc = right_rel.schema.column(col)
-                    conjuncts.append(ir.BCmp(
-                        "=",
-                        ir.BCol(lrel.cid(col), lc.dtype, lrel.table, col,
-                                lrel.rel_index),
-                        ir.BCol(right_rel.cid(col), rc.dtype, right_rel.table,
-                                col, right_rel.rel_index)))
-            elif item.condition is not None:
-                e = self.bind_expr(item.condition, scope)
-                conjuncts.extend(ir.split_conjuncts(e))
+            on = self._bind_join_condition(item, rels, n_before, scope)
+            if item.join_type in ("inner", "cross"):
+                conjuncts.extend(on)
+                return
+            # outer join: the right side must be a single relation (the
+            # reference handles arbitrary trees; v1 covers the dominant
+            # pattern — tree LEFT/RIGHT/FULL JOIN rel ON ...)
+            if len(rels) - n_before != 1:
+                raise PlanningError(
+                    "outer join right side must be a single table")
+            if not on:
+                raise PlanningError(
+                    "outer joins require an ON/USING condition")
+            tree = frozenset(range(n0, n_before))
+            spec = OuterJoinSpec(item.join_type, tree, n_before, tuple(on))
+            outer_joins.append(spec)
+            if item.join_type in ("left", "full"):
+                nullable.add(n_before)
+            if item.join_type in ("right", "full"):
+                nullable.update(tree)
             return
         raise PlanningError(f"unsupported FROM item {type(item).__name__}")
+
+    def _bind_join_condition(self, item: ast.Join, rels, n_before: int,
+                             scope: "_Scope") -> list[ir.BExpr]:
+        out: list[ir.BExpr] = []
+        if item.using_cols:
+            right_rel = rels[n_before]
+            left_rels = rels[:n_before]
+            for col in item.using_cols:
+                lrel = _rel_with_column(left_rels, col)
+                if lrel is None:
+                    raise PlanningError(
+                        f"USING column {col!r} not found on left side")
+                if not right_rel.schema.has_column(col):
+                    raise PlanningError(
+                        f"USING column {col!r} not found on right side")
+                lc = lrel.schema.column(col)
+                rc = right_rel.schema.column(col)
+                out.append(ir.BCmp(
+                    "=",
+                    ir.BCol(lrel.cid(col), lc.dtype, lrel.table, col,
+                            lrel.rel_index),
+                    ir.BCol(right_rel.cid(col), rc.dtype, right_rel.table,
+                            col, right_rel.rel_index)))
+        elif item.condition is not None:
+            e = self.bind_expr(item.condition, scope)
+            out.extend(ir.split_conjuncts(e))
+        return out
 
     # -- expressions -------------------------------------------------------
     def bind_expr(self, e: ast.Expr, scope: "_Scope",

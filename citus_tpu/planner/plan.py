@@ -91,6 +91,13 @@ class JoinNode(PlanNode):
     # partner's distribution column — the shuffle hashes ONLY that key
     # (hashing all keys would route rows off the partner's shards)
     repart_key_idx: int = 0
+    # inner | left | right | full — relative to THIS node's sides ('left'
+    # preserves the probe/left side, 'right' the build/right side)
+    join_type: str = "inner"
+    # single-side ON predicates of an outer join: gate matching without
+    # filtering the preserved side's rows (ON vs WHERE distinction)
+    left_match_filter: Optional[ir.BExpr] = None
+    right_match_filter: Optional[ir.BExpr] = None
 
 
 @dataclass
@@ -122,6 +129,15 @@ class ProjectNode(PlanNode):
 # --------------------------------------------------------------------------
 # planner context
 # --------------------------------------------------------------------------
+
+def table_placement(catalog: Catalog, table: str,
+                    n_devices: int) -> tuple[int, ...]:
+    """shard index → device index map (the single source of the
+    node→device folding rule; feed placement and planners must agree)."""
+    return tuple(
+        (catalog.active_placement(s.shard_id).node_id - 1) % n_devices
+        for s in catalog.table_shards(table))
+
 
 class StatsProvider:
     """Row counts + column cardinalities for capacity planning
@@ -178,9 +194,7 @@ class DistributedPlanner:
             # controller-local tables are fed replicated for now
             return Dist("replicated")
         shards = self.catalog.table_shards(rel.table)
-        placement = tuple(
-            (self.catalog.active_placement(s.shard_id).node_id - 1)
-            % self.n_devices for s in shards)
+        placement = table_placement(self.catalog, rel.table, self.n_devices)
         return Dist("hash",
                     frozenset({rel.cid(meta.distribution_column)}),
                     len(shards), placement)
@@ -192,12 +206,38 @@ class DistributedPlanner:
     # -- entry -------------------------------------------------------------
     def plan(self, q: BoundQuery) -> QueryPlan:
         needed = self._collect_needed_columns(q)
+
+        # WHERE conjuncts over NULL-extendable rels apply AFTER the outer
+        # join (null extension precedes WHERE); the rest participate in
+        # inner planning / scan pushdown as before
+        inner_conjuncts: list[ir.BExpr] = []
+        post_conjuncts: list[ir.BExpr] = []
+        for c in q.conjuncts:
+            rels = {n.rel_index for n in ir.walk(c) if isinstance(n, ir.BCol)}
+            if rels & q.nullable_rels:
+                post_conjuncts.append(c)
+            else:
+                inner_conjuncts.append(c)
+
+        # classify each outer join's ON clause: equi edges, single-side
+        # gates, and predicates pushable into a non-preserved side's scan
+        outer_info = []
+        push_extra: dict[int, list[ir.BExpr]] = {}
+        for spec in q.outer_joins:
+            info = self._classify_outer_on(spec, q)
+            outer_info.append(info)
+            for ri, cs in info["push"].items():
+                push_extra.setdefault(ri, []).extend(cs)
+
         scans = {}
         for rel in q.rels:
             cols = sorted(needed.get(rel.rel_index, set()))
-            scans[rel.rel_index] = self._make_scan(rel, cols, q.conjuncts)
+            rel_conjuncts = inner_conjuncts + push_extra.get(
+                rel.rel_index, [])
+            scans[rel.rel_index] = self._make_scan(rel, cols, rel_conjuncts)
 
-        joined = self._plan_joins(q, scans)
+        joined = self._plan_joins(q, scans, inner_conjuncts, post_conjuncts,
+                                  outer_info)
 
         decode: dict[str, tuple[str, str]] = {}
         if q.is_aggregate or q.distinct:
@@ -225,6 +265,9 @@ class DistributedPlanner:
 
         for c in q.conjuncts:
             visit(c)
+        for spec in q.outer_joins:
+            for c in spec.on:
+                visit(c)
         for e, _ in q.select:
             visit(e)
         for g in q.group_by:
@@ -288,16 +331,107 @@ class DistributedPlanner:
             candidates = idx if candidates is None else (candidates & idx)
         return sorted(candidates) if candidates is not None else None
 
+    # -- outer joins -------------------------------------------------------
+    def _classify_outer_on(self, spec, q: BoundQuery) -> dict:
+        """ON conjuncts → equi edges + single-side gates + scan pushdowns.
+
+        A predicate over only the NON-preserved side may push into that
+        side's scan (its rows vanish from the result anyway); a predicate
+        over only the PRESERVED side becomes a match gate (rows failing it
+        still emit, null-extended).  Cross-side non-equi residuals are not
+        supported with outer joins yet."""
+        right = spec.right_rel_index
+        edges = []
+        left_gate: list[ir.BExpr] = []
+        right_gate: list[ir.BExpr] = []
+        push: dict[int, list[ir.BExpr]] = {}
+        for c in spec.on:
+            rels = {n.rel_index for n in ir.walk(c) if isinstance(n, ir.BCol)}
+            if rels <= {right}:
+                if spec.join_type == "left":
+                    push.setdefault(right, []).append(c)
+                else:  # right/full preserve the right side → gate only
+                    right_gate.append(c)
+                continue
+            if right not in rels:
+                if spec.join_type == "right" and len(rels) == 1:
+                    push.setdefault(next(iter(rels)), []).append(c)
+                else:  # left/full preserve the tree side → gate only
+                    left_gate.append(c)
+                continue
+            if (isinstance(c, ir.BCmp) and c.op == "=" and len(rels) == 2):
+                lrels = {n.rel_index for n in ir.walk(c.left)
+                         if isinstance(n, ir.BCol)}
+                rrels = {n.rel_index for n in ir.walk(c.right)
+                         if isinstance(n, ir.BCol)}
+                if len(lrels) == 1 and len(rrels) == 1 and lrels != rrels:
+                    edges.append((frozenset(rels), c.left, c.right))
+                    continue
+            raise PlanningError(
+                "outer join ON supports equality keys and single-side "
+                "predicates only")
+        if not edges:
+            raise PlanningError("outer joins require an equality join key")
+        return {"spec": spec, "edges": edges, "left_gate": left_gate,
+                "right_gate": right_gate, "push": push}
+
+    def _apply_outer_join(self, current: PlanNode, scan: ScanNode,
+                          info: dict, placed: set[int]) -> PlanNode:
+        spec = info["spec"]
+        if spec.join_type in ("right", "full") and \
+                placed != set(spec.tree_rels):
+            raise PlanningError(
+                f"{spec.join_type.upper()} JOIN cannot combine with other "
+                "FROM entries (its left side must be the whole join tree)")
+        strategy = self._choose_strategy(current, scan, info["edges"])
+        if strategy in ("cartesian", "cartesian_broadcast"):
+            raise PlanningError("outer joins require an equality join key")
+        node = self._make_join(current, scan, info["edges"], strategy,
+                               scan.rel.rel_index,
+                               join_type=spec.join_type)
+        # gates are relative to (tree=left, rel=right); _make_join swapped
+        # sides (and flipped join_type) for broadcast_left
+        swapped = node.left is scan
+        node.left_match_filter = ir.make_and(
+            info["right_gate"] if swapped else info["left_gate"])
+        node.right_match_filter = ir.make_and(
+            info["left_gate"] if swapped else info["right_gate"])
+        return node
+
     # -- join order + strategies ------------------------------------------
-    def _plan_joins(self, q: BoundQuery,
-                    scans: dict[int, ScanNode]) -> PlanNode:
+    def _plan_joins(self, q: BoundQuery, scans: dict[int, ScanNode],
+                    inner_conjuncts: list[ir.BExpr],
+                    post_conjuncts: list[ir.BExpr],
+                    outer_info: list[dict]) -> PlanNode:
+        outer_rels = {s.right_rel_index for s in q.outer_joins}
+        inner_scans = {ri: s for ri, s in scans.items()
+                       if ri not in outer_rels}
+        current = self._plan_inner_joins(q, inner_scans, inner_conjuncts)
+        placed = set(inner_scans)
+        for info in outer_info:
+            spec = info["spec"]
+            current = self._apply_outer_join(
+                current, scans[spec.right_rel_index], info, placed)
+            placed.add(spec.right_rel_index)
+        if post_conjuncts:
+            if not isinstance(current, JoinNode):
+                raise PlanningError(
+                    "internal: post-join filter without a join")
+            res = ir.make_and(post_conjuncts)
+            current.residual = (res if current.residual is None
+                                else ir.make_and([current.residual, res]))
+        return current
+
+    def _plan_inner_joins(self, q: BoundQuery,
+                          scans: dict[int, ScanNode],
+                          conjuncts: list[ir.BExpr]) -> PlanNode:
         if len(scans) == 1:
             return next(iter(scans.values()))
 
         # classify cross-rel conjuncts into equi-join edges vs residuals
         edges = []      # (rel_set, left_expr, right_expr)
         residuals = []  # (rel_set, expr)
-        for c in q.conjuncts:
+        for c in conjuncts:
             rels = {n.rel_index for n in ir.walk(c) if isinstance(n, ir.BCol)}
             if len(rels) <= 1:
                 continue
@@ -393,7 +527,8 @@ class DistributedPlanner:
         return "repart_both"
 
     def _make_join(self, left: PlanNode, right: ScanNode, join_edges,
-                   strategy: str, right_rel_index: int) -> JoinNode:
+                   strategy: str, right_rel_index: int,
+                   join_type: str = "inner") -> JoinNode:
         left_keys, right_keys = [], []
         for _, a, b in join_edges:
             a_rels = {n.rel_index for n in ir.walk(a) if isinstance(n, ir.BCol)}
@@ -418,13 +553,17 @@ class DistributedPlanner:
             node.out_columns = {**left.out_columns, **right.out_columns}
             return node
         if strategy == "broadcast_left":
-            # swap so the replicated side is the broadcast (right) side
+            # swap so the replicated side is the broadcast (right) side;
+            # outer direction flips with the sides (LEFT ↔ RIGHT)
             node = JoinNode(strategy="broadcast", left=right, right=left,
-                            left_keys=right_keys, right_keys=left_keys)
+                            left_keys=right_keys, right_keys=left_keys,
+                            join_type={"left": "right", "right": "left"}.get(
+                                join_type, join_type))
             node.dist = right.dist
         else:
             node = JoinNode(strategy=strategy, left=left, right=right,
-                            left_keys=left_keys, right_keys=right_keys)
+                            left_keys=left_keys, right_keys=right_keys,
+                            join_type=join_type)
         # per-edge cid sets, index-aligned with left_keys/right_keys
         edge_lcids = [frozenset(n.cid for n in ir.walk(e)
                                 if isinstance(n, ir.BCol))
@@ -485,6 +624,18 @@ class DistributedPlanner:
         elif strategy == "cartesian":
             raise PlanningError(
                 "cartesian products are not supported (add a join clause)")
+        if node.join_type != "inner" and node.dist is not None:
+            # null-extended rows carry NULL partition values, so only the
+            # preserved side's own partition columns survive as a reliable
+            # distribution property (no equivalence-extension either)
+            if node.join_type == "left":
+                keep = node.dist.cids & node.left.dist.cids
+            elif node.join_type == "right":
+                keep = node.dist.cids & node.right.dist.cids
+            else:
+                keep = frozenset()
+            node.dist = Dist(node.dist.kind, keep, node.dist.shard_count,
+                             node.dist.placement)
         node.est_rows = max(left.est_rows, right.est_rows)
         node.out_columns = {**left.out_columns, **right.out_columns}
         return node
@@ -560,7 +711,7 @@ class DistributedPlanner:
             combine="", input=input_node,
             group_keys=group_keys, aggs=aggs)
         node.est_groups = self._estimate_groups(group_keys, input_node)
-        self._plan_dense_grid(node)
+        self._plan_dense_grid(node, q.nullable_rels)
         gk_cids = set()
         for g, _ in group_keys:
             if isinstance(g, ir.BCol):
@@ -584,7 +735,8 @@ class DistributedPlanner:
 
     DENSE_GROUP_LIMIT = 8192
 
-    def _plan_dense_grid(self, node: AggregateNode) -> None:
+    def _plan_dense_grid(self, node: AggregateNode,
+                         nullable_rels: frozenset = frozenset()) -> None:
         """Annotate the aggregate with dense-slot metadata when every
         group key is a bare column over a known small value range."""
         if not node.group_keys:
@@ -598,7 +750,10 @@ class DistributedPlanner:
             if ext is None or ext[1] <= 0:
                 return
             base, extent = ext
-            has_null = self._column_nullable(g)
+            # outer-join null extension can make any column NULL at
+            # runtime regardless of its schema nullability
+            has_null = (self._column_nullable(g)
+                        or g.rel_index in nullable_rels)
             specs.append((int(base), int(extent), has_null))
             total *= extent + (1 if has_null else 0)
             if total > self.DENSE_GROUP_LIMIT:
