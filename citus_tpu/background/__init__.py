@@ -1,0 +1,7 @@
+"""Background services: job runner + maintenance daemon."""
+
+from .jobs import BackgroundJobRunner, BackgroundTask, JobStatus
+from .daemon import MaintenanceDaemon
+
+__all__ = ["BackgroundJobRunner", "BackgroundTask", "JobStatus",
+           "MaintenanceDaemon"]

@@ -1,0 +1,97 @@
+"""Maintenance daemon: periodic 2PC recovery, deferred cleanup, deadlock
+checks.
+
+The reference runs one bgworker per database
+(/root/reference/src/backend/distributed/utils/maintenanced.c:460
+CitusMaintenanceDaemonMain) that periodically recovers prepared
+transactions (:612, every citus.recover_2pc_interval), cleans deferred
+resources (shard_cleaner.c), and checks for distributed deadlocks.
+
+Single-controller mapping: a daemon thread per Session, tick-driven, each
+duty on its own interval read live from the session settings
+(recover_2pc_interval_ms / defer_shard_delete_interval_ms; -1 disables).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+TICK_SECONDS = 0.05
+
+
+class MaintenanceDaemon:
+    def __init__(self, session):
+        self.session = session
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._last_recover = 0.0
+        self._last_cleanup = 0.0
+        self._last_deadlock = 0.0
+        # observability: how many times each duty ran
+        self.recover_runs = 0
+        self.cleanup_runs = 0
+        self.deadlock_checks = 0
+
+    def start(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            return
+        # each duty waits one full interval after start (session open
+        # already ran recovery + sweep synchronously)
+        now = time.monotonic()
+        self._last_recover = self._last_cleanup = self._last_deadlock = now
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="citus-tpu-maintenanced")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    # -- duties ------------------------------------------------------------
+    def _loop(self) -> None:
+        while not self._stop.wait(TICK_SECONDS):
+            now = time.monotonic()
+            try:
+                self._maybe_recover(now)
+                self._maybe_cleanup(now)
+                self._maybe_deadlock_check(now)
+            except Exception:
+                # the daemon must survive transient errors (the reference
+                # daemon catches and retries on its next wakeup)
+                pass
+
+    def _interval(self, name: str) -> float | None:
+        ms = self.session.settings.get(name)
+        return None if ms is None or ms < 0 else ms / 1000.0
+
+    def _maybe_recover(self, now: float) -> None:
+        iv = self._interval("recover_2pc_interval_ms")
+        if iv is None or now - self._last_recover < iv:
+            return
+        self._last_recover = now
+        self.session.txn_manager.recover()
+        self.recover_runs += 1
+
+    def _maybe_cleanup(self, now: float) -> None:
+        iv = self._interval("defer_shard_delete_interval_ms")
+        if iv is None or now - self._last_cleanup < iv:
+            return
+        self._last_cleanup = now
+        from ..operations.cleanup import cleanup_registry_for
+
+        cleanup_registry_for(self.session.data_dir).sweep(
+            self.session.store, self.session.catalog)
+        self.cleanup_runs += 1
+
+    def _maybe_deadlock_check(self, now: float) -> None:
+        # ref: distributed_deadlock_detection_factor × 2s; we reuse the
+        # lock manager's own detector on a fixed 1s cadence
+        if now - self._last_deadlock < 1.0:
+            return
+        self._last_deadlock = now
+        self.session.locks.check_deadlocks()
+        self.deadlock_checks += 1

@@ -1,0 +1,191 @@
+"""Background job runner: dependency-ordered parallel task execution.
+
+The reference schedules background work (rebalancer moves, etc.) as rows
+in pg_dist_background_job / pg_dist_background_task with inter-task
+dependencies and per-node concurrency caps, executed by bgworkers
+(/root/reference/src/backend/distributed/utils/background_jobs.c:150
+citus_job_cancel, :192 citus_job_wait; catalog
+src/include/distributed/pg_dist_background_job.h).
+
+Single-controller mapping: jobs are in-process task DAGs run by a bounded
+worker pool.  Tasks are Python callables; state is queryable via
+job_status()/task rows (the citus_job_* UDF surface) and integrates with
+the progress registry.
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+import traceback
+from dataclasses import dataclass, field
+
+
+class JobStatus(enum.Enum):
+    SCHEDULED = "scheduled"
+    RUNNING = "running"
+    DONE = "done"
+    FAILED = "failed"
+    CANCELLED = "cancelled"
+
+
+@dataclass
+class BackgroundTask:
+    """pg_dist_background_task row analogue."""
+
+    task_id: int
+    job_id: int
+    fn: object                      # zero-arg callable
+    description: str = ""
+    depends_on: tuple[int, ...] = ()
+    status: JobStatus = JobStatus.SCHEDULED
+    error: str | None = None
+    result: object = None
+
+
+@dataclass
+class BackgroundJob:
+    """pg_dist_background_job row analogue."""
+
+    job_id: int
+    description: str
+    tasks: dict[int, BackgroundTask] = field(default_factory=dict)
+
+    @property
+    def status(self) -> JobStatus:
+        states = {t.status for t in self.tasks.values()}
+        if JobStatus.FAILED in states:
+            return JobStatus.FAILED
+        if JobStatus.CANCELLED in states:
+            return JobStatus.CANCELLED
+        if states <= {JobStatus.DONE}:
+            return JobStatus.DONE
+        if JobStatus.RUNNING in states:
+            return JobStatus.RUNNING
+        return JobStatus.SCHEDULED
+
+
+class BackgroundJobRunner:
+    """Bounded worker pool executing task DAGs."""
+
+    def __init__(self, max_executors: int = 4):
+        self.max_executors = max_executors
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._jobs: dict[int, BackgroundJob] = {}
+        self._next_job = 1
+        self._next_task = 1
+        self._workers: list[threading.Thread] = []
+        self._stop = False
+
+    # -- submission --------------------------------------------------------
+    def submit_job(self, description: str,
+                   tasks: list[tuple[object, str, list[int]]]) -> int:
+        """tasks: [(fn, description, depends_on_positions)] where
+        depends_on_positions index into this submission's task list.
+        Returns the job id."""
+        with self._lock:
+            job = BackgroundJob(self._next_job, description)
+            self._next_job += 1
+            ids: list[int] = []
+            for fn, desc, deps in tasks:
+                t = BackgroundTask(self._next_task, job.job_id, fn, desc,
+                                   tuple(ids[d] for d in deps))
+                self._next_task += 1
+                job.tasks[t.task_id] = t
+                ids.append(t.task_id)
+            self._jobs[job.job_id] = job
+            self._ensure_workers()
+            self._cv.notify_all()
+            return job.job_id
+
+    def _ensure_workers(self) -> None:
+        live = [w for w in self._workers if w.is_alive()]
+        self._workers = live
+        while len(self._workers) < self.max_executors:
+            w = threading.Thread(target=self._worker_loop, daemon=True,
+                                 name=f"citus-tpu-bgworker-{len(live)}")
+            self._workers.append(w)
+            w.start()
+
+    # -- execution ---------------------------------------------------------
+    def _claim(self) -> BackgroundTask | None:
+        for job in self._jobs.values():
+            for t in job.tasks.values():
+                if t.status is not JobStatus.SCHEDULED:
+                    continue
+                deps = [job.tasks[d] for d in t.depends_on]
+                if any(d.status in (JobStatus.FAILED, JobStatus.CANCELLED)
+                       for d in deps):
+                    t.status = JobStatus.CANCELLED
+                    t.error = "dependency failed"
+                    continue
+                if all(d.status is JobStatus.DONE for d in deps):
+                    t.status = JobStatus.RUNNING
+                    return t
+        return None
+
+    def _worker_loop(self) -> None:
+        while True:
+            with self._cv:
+                task = self._claim()
+                while task is None and not self._stop:
+                    self._cv.wait(timeout=0.2)
+                    task = self._claim()
+                if self._stop:
+                    return
+            try:
+                task.result = task.fn()
+                with self._cv:
+                    task.status = JobStatus.DONE
+                    self._cv.notify_all()
+            except Exception as exc:
+                with self._cv:
+                    task.status = JobStatus.FAILED
+                    task.error = "".join(traceback.format_exception_only(
+                        type(exc), exc)).strip()
+                    self._cv.notify_all()
+
+    # -- control (citus_job_wait / citus_job_cancel analogues) -------------
+    def wait(self, job_id: int, timeout: float = 3600.0) -> JobStatus:
+        import time
+
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while True:
+                job = self._jobs.get(job_id)
+                if job is None:
+                    raise KeyError(f"job {job_id} does not exist")
+                if job.status in (JobStatus.DONE, JobStatus.FAILED,
+                                  JobStatus.CANCELLED):
+                    return job.status
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(f"job {job_id} still running")
+                self._cv.wait(timeout=min(remaining, 0.2))
+
+    def cancel(self, job_id: int) -> None:
+        with self._cv:
+            job = self._jobs.get(job_id)
+            if job is None:
+                raise KeyError(f"job {job_id} does not exist")
+            for t in job.tasks.values():
+                if t.status is JobStatus.SCHEDULED:
+                    t.status = JobStatus.CANCELLED
+            self._cv.notify_all()
+
+    def job_status(self, job_id: int) -> BackgroundJob:
+        with self._lock:
+            job = self._jobs.get(job_id)
+            if job is None:
+                raise KeyError(f"job {job_id} does not exist")
+            return job
+
+    def jobs(self) -> list[BackgroundJob]:
+        with self._lock:
+            return list(self._jobs.values())
+
+    def shutdown(self) -> None:
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()

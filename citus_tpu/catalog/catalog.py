@@ -280,13 +280,29 @@ class Catalog:
 
     def table_shards(self, name: str) -> list[ShardInterval]:
         self.table(name)
-        return sorted((s for s in self.shards.values() if s.table_name == name),
-                      key=lambda s: s.shard_index)
+        with self._lock:  # background moves/splits mutate concurrently
+            return sorted((s for s in self.shards.values()
+                           if s.table_name == name),
+                          key=lambda s: s.shard_index)
+
+    def shard_mins(self, name: str):
+        """Ascending token-range lower bounds per shard (index-aligned
+        with table_shards) — the routing table for range-aware shard
+        lookup after splits."""
+        import numpy as np
+
+        shards = self.table_shards(name)
+        mins = [s.min_value for s in shards]
+        if any(m is None for m in mins):
+            raise CatalogError(f"table {name!r} is not hash-distributed")
+        return np.asarray(mins, dtype=np.int64)
 
     def shard_placements(self, shard_id: int) -> list[ShardPlacement]:
-        return sorted((p for p in self.placements.values()
-                       if p.shard_id == shard_id and p.shard_state == "active"),
-                      key=lambda p: p.placement_id)
+        with self._lock:
+            return sorted((p for p in self.placements.values()
+                           if p.shard_id == shard_id
+                           and p.shard_state == "active"),
+                          key=lambda p: p.placement_id)
 
     def active_placement(self, shard_id: int) -> ShardPlacement:
         ps = self.shard_placements(shard_id)

@@ -95,6 +95,19 @@ def shard_index_for_values(values: np.ndarray, shard_count: int) -> np.ndarray:
     return shard_index_for_token(hash_token(values), shard_count)
 
 
+def shard_index_for_token_ranges(tokens: np.ndarray,
+                                 mins: np.ndarray) -> np.ndarray:
+    """Token → shard index over EXPLICIT contiguous ranges (mins ascending,
+    shard i covering [mins[i], mins[i+1]-1]).  The range-aware twin of
+    shard_index_for_token for tables whose shards have been split
+    (operations/shard_split.c analogue) and no longer sit on the uniform
+    increment grid."""
+    mins = np.asarray(mins, dtype=np.int64)
+    idx = np.searchsorted(mins, np.asarray(tokens, dtype=np.int64),
+                          side="right") - 1
+    return np.clip(idx, 0, len(mins) - 1).astype(np.int32)
+
+
 @dataclass(frozen=True)
 class ShardInterval:
     """One shard of a distributed table (pg_dist_shard row analogue;

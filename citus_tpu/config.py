@@ -50,26 +50,13 @@ _register(ConfigVar(
     "Number of hash shards for new distributed tables "
     "(ref: citus.shard_count, shared_library_init.c:2616).",
     int, min_value=1, max_value=64000))
-_register(ConfigVar(
-    "shard_replication_factor", 1,
-    "Placement replicas per shard (ref: citus.shard_replication_factor).",
-    int, min_value=1, max_value=100))
 
 # --- executor -------------------------------------------------------------
-_register(ConfigVar(
-    "max_adaptive_executor_pool_size", 16,
-    "Max concurrent host-side tasks per node — bounds async dispatch "
-    "(ref: citus.max_adaptive_executor_pool_size, shared_library_init.c:2087).",
-    int, min_value=1, max_value=1024))
 _register(ConfigVar(
     "enable_repartition_joins", True,
     "Allow dual/single repartition (all_to_all) joins "
     "(ref: citus.enable_repartition_joins, shared_library_init.c:1609).",
     bool))
-_register(ConfigVar(
-    "task_assignment_policy", "greedy",
-    "How tasks map to placements (ref: citus.task_assignment_policy).",
-    str, choices=("greedy", "round-robin", "first-replica")))
 _register(ConfigVar(
     "compute_dtype", "float32",
     "Device accumulation dtype: float32 (TPU-fast) or float64 (exact; CPU "
@@ -98,11 +85,6 @@ _register(ConfigVar(
     "HBM byte budget for device-resident table feeds reused across "
     "queries (ref: connection/pool reuse, executor/adaptive_executor.c:962).",
     int, min_value=0, max_value=1 << 40))
-_register(ConfigVar(
-    "enable_pallas_kernels", True,
-    "Use hand-written Pallas TPU kernels for hot ops where available; "
-    "fall back to pure XLA lowering otherwise.",
-    bool))
 
 # --- columnar storage (ref: columnar GUCs + columnar.options catalog) -----
 _register(ConfigVar(
@@ -128,11 +110,6 @@ _register(ConfigVar(
     "Rows parsed per ingest batch before routing "
     "(analogue of per-shard COPY buffering, commands/multi_copy.c).",
     int, min_value=1024, max_value=4_000_000))
-_register(ConfigVar(
-    "enable_binary_protocol", True,
-    "Use binary (numpy) interchange between host stages instead of text "
-    "(ref: citus.enable_binary_protocol, shared_library_init.c:1342).",
-    bool))
 
 # --- transactions / maintenance ------------------------------------------
 _register(ConfigVar(
@@ -161,14 +138,6 @@ _register(ConfigVar(
     float, min_value=0.0, max_value=1.0))
 
 # --- planner --------------------------------------------------------------
-_register(ConfigVar(
-    "enable_fast_path_router_planner", True,
-    "Enable the single-shard fast path "
-    "(ref: citus.enable_fast_path_router_planner).", bool))
-_register(ConfigVar(
-    "limit_clause_row_fetch_count", -1,
-    "Rows workers return for unpushable LIMITs (ref same name).",
-    int, min_value=-1, max_value=2**31 - 1))
 _register(ConfigVar(
     "log_distributed_plans", False,
     "Debug-log every distributed plan chosen (ref: citus.log_multi_join_order "

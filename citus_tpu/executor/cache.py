@@ -38,7 +38,7 @@ from ..planner.plan import (
 
 def _dist_sig(dist) -> str:
     return (f"{dist.kind}:{sorted(dist.cids)}:{dist.shard_count}:"
-            f"{dist.placement}")
+            f"{dist.placement}:{dist.bounds}")
 
 
 def node_fingerprint(node: PlanNode) -> str:

@@ -147,6 +147,18 @@ class Capacities:
                           {k: v * 2 for k, v in self.join_out.items()},
                           {k: v * 2 for k, v in self.agg_out.items()})
 
+    def grown(self, overflow: int) -> "Capacities":
+        """Retry sizing: at least double, and at least enough for the
+        observed overflow (expand_join reports exact total-minus-capacity,
+        so one retry usually suffices even for 100× join fan-out)."""
+
+        def g(v: int) -> int:
+            return _round_cap(max(v * 2, v + int(overflow)))
+
+        return Capacities({k: g(v) for k, v in self.repartition.items()},
+                          {k: g(v) for k, v in self.join_out.items()},
+                          {k: g(v) for k, v in self.agg_out.items()})
+
 
 class PlanCompiler:
     """One instance per (plan, feeds, capacities) — produces a jitted fn."""
@@ -329,7 +341,8 @@ class PlanCompiler:
                      placement: tuple[int, ...], capacity: int,
                      key_arrays: list | None = None,
                      valid: jnp.ndarray | None = None,
-                     keep_null_rows: bool = False) -> Block:
+                     keep_null_rows: bool = False,
+                     bounds: tuple[int, ...] | None = None) -> Block:
         """pack → all_to_all → flatten: the map+fetch phases fused.
 
         When repartitioning toward a TABLE's sharding (repart_left/right),
@@ -353,9 +366,17 @@ class PlanCompiler:
             h = combine_hash64(key_arrays)
             token = ((h & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32)
                      .astype(jnp.int64) + INT32_MIN).astype(jnp.int32)
-        increment = HASH_TOKEN_COUNT // shard_count
-        shard = jnp.minimum((token.astype(jnp.int64) - INT32_MIN) // increment,
-                            shard_count - 1).astype(jnp.int32)
+        if bounds is not None:
+            # range-aware routing: shard bounds are arbitrary after splits
+            mins = jnp.asarray(np.asarray(bounds, dtype=np.int64))
+            shard = (jnp.searchsorted(mins, token.astype(jnp.int64),
+                                      side="right") - 1).clip(
+                0, shard_count - 1).astype(jnp.int32)
+        else:
+            increment = HASH_TOKEN_COUNT // shard_count
+            shard = jnp.minimum(
+                (token.astype(jnp.int64) - INT32_MIN) // increment,
+                shard_count - 1).astype(jnp.int32)
         placement_arr = jnp.asarray(np.asarray(placement, dtype=np.int32))
         target = placement_arr[shard]
 
@@ -398,14 +419,16 @@ class PlanCompiler:
                                      [node.right_keys[node.repart_key_idx]],
                                      node.left.dist.shard_count,
                                      node.left.dist.placement, cap,
-                                     keep_null_rows=keep_r)
+                                     keep_null_rows=keep_r,
+                                     bounds=node.left.dist.bounds or None)
         elif node.strategy == "repart_left":
             cap = self.caps.repartition[id(node)]
             lblk = self._repartition(lblk,
                                      [node.left_keys[node.repart_key_idx]],
                                      node.right.dist.shard_count,
                                      node.right.dist.placement, cap,
-                                     keep_null_rows=keep_l)
+                                     keep_null_rows=keep_l,
+                                     bounds=node.right.dist.bounds or None)
         elif node.strategy == "repart_both":
             cap = self.caps.repartition[id(node)]
             identity = tuple(range(self.n_dev))

@@ -21,7 +21,7 @@ from __future__ import annotations
 import numpy as np
 
 from ..catalog import DistributionMethod
-from ..catalog.distribution import hash_token, shard_index_for_token
+from ..catalog.distribution import hash_token, shard_index_for_token_ranges
 from ..errors import ExecutionError, PlanningError, UnsupportedQueryError
 from ..planner import expr as ir
 from ..planner.bind import Binder
@@ -57,12 +57,13 @@ def _bind_single_table(session, table: str, alias: str | None,
 def _target_shards(session, table: str, rel, conjuncts):
     """All shards, narrowed by distribution-column pruning when possible."""
     from ..planner.plan import DistributedPlanner
-    from ..session import _StoreStats
+    from ..session import _StoreDicts, _StoreStats
 
     shards = session.catalog.table_shards(table)
     planner = DistributedPlanner(session.catalog,
                                  _StoreStats(session.store),
-                                 session.n_devices, True)
+                                 session.n_devices, True,
+                                 dicts=_StoreDicts(session.store))
     pruned = planner._prune_shards(rel, conjuncts)
     if pruned is not None:
         keep = set(pruned)
@@ -405,7 +406,9 @@ def execute_merge(session, stmt: ast.Merge):
             tokens = hash_token(np.asarray(
                 [0 if x is None else x for x in dv], dtype=dt.numpy_dtype))
         src_shard = np.asarray(
-            shard_index_for_token(tokens, len(shards)), dtype=np.int64)
+            shard_index_for_token_ranges(
+                tokens, session.catalog.shard_mins(stmt.target)),
+            dtype=np.int64)
         if dn is not None:
             # NULL join keys never match; those source rows go straight to
             # WHEN NOT MATCHED handling (PostgreSQL semantics)

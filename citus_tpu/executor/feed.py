@@ -44,14 +44,84 @@ def walk_plan(node: PlanNode):
 
 def build_feeds(plan: QueryPlan, catalog: Catalog, store: TableStore,
                 mesh: Mesh, compute_dtype=np.float32,
-                cache=None) -> dict[int, FeedSpec]:
+                cache=None, counters=None) -> dict[int, FeedSpec]:
     feeds: dict[int, FeedSpec] = {}
     for node in walk_plan(plan.root):
         if isinstance(node, ScanNode):
             feeds[id(node)] = _feed_scan_cached(node, catalog, store, mesh,
                                                 plan.n_devices, compute_dtype,
-                                                cache)
+                                                cache, counters)
     return feeds
+
+
+def skippable_tests(filter_expr) -> tuple:
+    """Canonical (col, op, value) skip tests from a scan filter — also the
+    feed-cache key component (feeds built under different chunk filters
+    hold different rows and must not share a cache slot)."""
+    from ..planner import expr as ir
+
+    if filter_expr is None:
+        return ()
+    _FLIP = {"<": ">", "<=": ">=", ">": "<", ">=": "<=", "=": "="}
+    tests: list[tuple[str, str, object]] = []
+    for c in ir.split_conjuncts(filter_expr):
+        if isinstance(c, ir.BCmp) and c.op in _FLIP:
+            if isinstance(c.left, ir.BCol) and isinstance(c.right, ir.BConst) \
+                    and c.right.value is not None:
+                tests.append((c.left.cid.split(".", 1)[1], c.op,
+                              c.right.value))
+            elif isinstance(c.right, ir.BCol) and \
+                    isinstance(c.left, ir.BConst) and c.left.value is not None:
+                tests.append((c.right.cid.split(".", 1)[1], _FLIP[c.op],
+                              c.left.value))
+        elif isinstance(c, ir.BInConst) and not c.negated and \
+                isinstance(c.operand, ir.BCol) and c.values:
+            tests.append((c.operand.cid.split(".", 1)[1], "in",
+                          tuple(c.values)))
+    return tuple(sorted(tests, key=repr))
+
+
+def make_chunk_filter(filter_expr, counters=None):
+    """ScanNode filter → per-chunk min/max skip predicate.
+
+    The chunk-granularity PruneShards analogue (reference:
+    columnar_reader.c:323 chunk-group filtering over ColumnChunkSkipNode
+    min/max).  Handles AND-ed `col <op> const` comparisons and positive
+    IN-lists (string predicates arrive as dictionary-code IN-lists from
+    the binder); any unsatisfiable conjunct skips the whole chunk.
+    Returns None when the filter has no skippable shape.
+    """
+    tests = skippable_tests(filter_expr)
+    if not tests:
+        return None
+
+    def chunk_filter(stats: dict) -> bool:
+        for col, op, val in tests:
+            s = stats.get(col)
+            if s is None:
+                continue
+            mn, mx, _nulls = s
+            if mn is None:
+                # no stats for this column (e.g. dictionary-coded strings
+                # in older stripes) — cannot conclude anything
+                continue
+            ok = ((op == "<" and mn < val) or (op == "<=" and mn <= val)
+                  or (op == ">" and mx > val) or (op == ">=" and mx >= val)
+                  or (op == "=" and mn <= val <= mx)
+                  or (op == "in" and any(mn <= v <= mx for v in val)))
+            if not ok:
+                _count_skip(counters)
+                return False
+        return True
+
+    return chunk_filter
+
+
+def _count_skip(counters) -> None:
+    if counters is not None:
+        from ..stats.counters import CHUNKS_SKIPPED
+
+        counters.increment(CHUNKS_SKIPPED)
 
 
 def _overlay_touches(store: TableStore, table: str) -> bool:
@@ -64,27 +134,30 @@ def _overlay_touches(store: TableStore, table: str) -> bool:
 
 def _feed_scan_cached(node: ScanNode, catalog: Catalog, store: TableStore,
                       mesh: Mesh, n_dev: int, compute_dtype,
-                      cache) -> FeedSpec:
+                      cache, counters=None) -> FeedSpec:
     """Device-feed cache wrapper: HBM-resident table arrays keyed on
     (table, columns, pruning, placement, data version) — see
     executor/cache.py.  Open-transaction overlays bypass the cache (their
     visibility is session-private and changes mid-transaction)."""
     table = node.rel.table
     if cache is None or _overlay_touches(store, table):
-        return _feed_scan(node, catalog, store, mesh, n_dev, compute_dtype)
+        return _feed_scan(node, catalog, store, mesh, n_dev, compute_dtype,
+                          counters)
     shards = catalog.table_shards(table)
     placement_sig = tuple(
         (s.shard_id, catalog.active_placement(s.shard_id).node_id)
         for s in shards)
     key = (table, store.data_version(table), tuple(node.columns),
            None if node.pruned_shards is None else tuple(node.pruned_shards),
-           n_dev, str(np.dtype(compute_dtype)), placement_sig)
+           n_dev, str(np.dtype(compute_dtype)), placement_sig,
+           skippable_tests(node.filter))
     entry = cache.get(key)
     if entry is None:
         # superseded versions of this table can never hit again — free
         # their HBM before resident-caching the fresh feed
         cache.invalidate_table(table, keep_version=key[1])
-        spec = _feed_scan(node, catalog, store, mesh, n_dev, compute_dtype)
+        spec = _feed_scan(node, catalog, store, mesh, n_dev, compute_dtype,
+                          counters)
         from .cache import CachedFeed
 
         nbytes = sum(int(np.dtype(a.dtype).itemsize * a.size)
@@ -101,11 +174,14 @@ def _feed_scan_cached(node: ScanNode, catalog: Catalog, store: TableStore,
 
 
 def _feed_scan(node: ScanNode, catalog: Catalog, store: TableStore,
-               mesh: Mesh, n_dev: int, compute_dtype) -> FeedSpec:
+               mesh: Mesh, n_dev: int, compute_dtype,
+               counters=None) -> FeedSpec:
     rel = node.rel
     meta = catalog.table(rel.table)
     colnames = [cid.split(".", 1)[1] for cid in node.columns]
     shards = catalog.table_shards(rel.table)
+    chunk_filter = (make_chunk_filter(node.filter, counters)
+                    if node.filter is not None else None)
 
     if meta.method == DistributionMethod.HASH:
         per_dev_vals: list[dict[str, list[np.ndarray]]] = [
@@ -120,7 +196,8 @@ def _feed_scan(node: ScanNode, catalog: Catalog, store: TableStore,
             if node.pruned_shards is not None and \
                     s.shard_index not in node.pruned_shards:
                 continue
-            vals, mask, n = store.read_shard(rel.table, s.shard_id, colnames)
+            vals, mask, n = store.read_shard(rel.table, s.shard_id, colnames,
+                                             chunk_filter)
             if n == 0:
                 continue
             per_dev_rows[dev] += n
@@ -158,7 +235,7 @@ def _feed_scan(node: ScanNode, catalog: Catalog, store: TableStore,
             raise ExecutionError(
                 f"table {rel.table}: expected single shard")
         vals, mask, n = store.read_shard(rel.table, shards[0].shard_id,
-                                         colnames)
+                                         colnames, chunk_filter)
         cap = _round_cap(max(n, 1))
         arrays, nulls = {}, {}
         for cid, cname in zip(node.columns, colnames):

@@ -30,7 +30,7 @@ from __future__ import annotations
 import numpy as np
 
 from ..catalog import DistributionMethod
-from ..catalog.distribution import hash_token, shard_index_for_token
+from ..catalog.distribution import hash_token, shard_index_for_token_ranges
 from ..errors import IngestError, PlanningError
 from ..planner import expr as ir
 from ..planner.plan import QueryPlan
@@ -198,7 +198,8 @@ def _write_result(session, meta, columns, result) -> int:
             else:
                 tokens = hash_token(typed[dist_col])
             shards = session.catalog.table_shards(table)
-            shard_idx = shard_index_for_token(tokens, len(shards))
+            shard_idx = shard_index_for_token_ranges(
+                tokens, session.catalog.shard_mins(table))
             for i, s in enumerate(shards):
                 mask = shard_idx == i
                 if not mask.any():

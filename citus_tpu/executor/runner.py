@@ -77,11 +77,12 @@ class ResultSet:
 
 class Executor:
     def __init__(self, catalog: Catalog, store: TableStore,
-                 settings: Settings, mesh: Mesh):
+                 settings: Settings, mesh: Mesh, counters=None):
         self.catalog = catalog
         self.store = store
         self.settings = settings
         self.mesh = mesh
+        self.counters = counters
         self.plan_cache = PlanCache(
             settings.get("max_cached_plans"))
         self.feed_cache = FeedCache(
@@ -91,7 +92,8 @@ class Executor:
     def execute_plan(self, plan: QueryPlan, raw: bool = False) -> ResultSet:
         compute_dtype = np.dtype(self.settings.get("compute_dtype"))
         feeds = build_feeds(plan, self.catalog, self.store, self.mesh,
-                            compute_dtype, cache=self.feed_cache)
+                            compute_dtype, cache=self.feed_cache,
+                            counters=self.counters)
         caps = self._initial_capacities(plan, feeds)
         fingerprint = (node_fingerprint(plan.root), plan.n_devices,
                        str(compute_dtype), feeds_signature(plan, feeds))
@@ -121,7 +123,7 @@ class Executor:
                 raise CapacityOverflowError(
                     f"buffer overflow persisted after {retries} retries "
                     f"({total_overflow} rows dropped)", total_overflow, 0)
-            caps = caps.doubled()
+            caps = caps.grown(total_overflow)
         cols, nulls, valid = unpack_outputs(packed, out_meta)
         result = self._host_combine(plan, cols, nulls, valid, raw)
         result.retries = retries
@@ -161,8 +163,11 @@ class Executor:
                     # cartesian: output is the full product
                     out = _round_cap(lcap * rcap)
                 else:
-                    # probe side is the left/outer side
-                    out = _round_cap(int(lcap * join_factor) + 128)
+                    # probe side is the left/outer side; est_expansion
+                    # scales for many-to-many fan-out
+                    out = _round_cap(int(
+                        lcap * join_factor
+                        * max(1.0, node.est_expansion)) + 128)
                     if node.join_type in ("left", "full"):
                         # unmatched probe rows add up to lcap extra slots
                         out = _round_cap(out + lcap)

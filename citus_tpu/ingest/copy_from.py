@@ -13,7 +13,7 @@ from __future__ import annotations
 import numpy as np
 
 from ..catalog import DistributionMethod
-from ..catalog.distribution import hash_token, shard_index_for_token
+from ..catalog.distribution import hash_token, shard_index_for_token_ranges
 from ..errors import IngestError
 from ..sql import ast
 from ..storage.dictionary import NULL_CODE, string_hash_tokens
@@ -121,8 +121,30 @@ def _ingest_batch(session, table: str, columns: list[str],
         tokens = _routing_tokens(session, table, dist_col,
                                  meta.schema.column(dist_col).dtype,
                                  typed[dist_col])
-        shard_idx = shard_index_for_token(tokens, len(shards))
         pending = []
+        # exclusive target-shard locks for autocommit ingest: a concurrent
+        # shard split must not flip the catalog between our routing and
+        # our manifest commit (in-transaction staging skips this; the
+        # DML paths hold their own locks).  Routing re-derives under the
+        # locks if the catalog moved while we waited.
+        lock_txid = None
+        if commit and getattr(session, "locks", None) is not None:
+            from ..transaction.clock import global_clock
+
+            lock_txid = global_clock.now()
+        while True:
+            version = session.catalog.version
+            shards = session.catalog.table_shards(table)
+            shard_idx = shard_index_for_token_ranges(
+                tokens, session.catalog.shard_mins(table))
+            if lock_txid is None:
+                break
+            for sid in sorted(s.shard_id for i, s in enumerate(shards)
+                              if bool((shard_idx == i).any())):
+                session.locks.acquire(lock_txid, (table, sid))
+            if session.catalog.version == version:
+                break
+            session.locks.release_all(lock_txid)
         try:
             for i, s in enumerate(shards):
                 mask = shard_idx == i
@@ -135,14 +157,17 @@ def _ingest_batch(session, table: str, columns: list[str],
                     table, s.shard_id, sub, subv, codec=codec, level=level,
                     chunk_rows=chunk_rows, commit=False)
                 pending.append((s.shard_id, rec))
+            if commit:
+                session.store.commit_pending(table, pending)
+                pending = []
         except Exception:
             # a failed later shard must not leak the earlier shards'
             # already-written (invisible) stripe files
             session.store.discard_pending(table, pending)
             raise
-        if commit:
-            session.store.commit_pending(table, pending)
-            pending = []
+        finally:
+            if lock_txid is not None:
+                session.locks.release_all(lock_txid)
     else:
         shard = session.catalog.table_shards(table)[0]
         rec = session.store.append_stripe(

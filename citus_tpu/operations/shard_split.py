@@ -1,0 +1,224 @@
+"""Online shard split + tenant isolation.
+
+The reference splits a shard by standing up child shards, streaming rows
+through logical replication with a custom WAL decoder, and flipping
+metadata under a write-block
+(/root/reference/src/backend/distributed/operations/shard_split.c,
+citus_split_shard_by_split_points.c; isolate_shards.c for tenant
+isolation).  With immutable columnar stripes the whole dance collapses to
+re-hash-and-rewrite:
+
+1. register child dirs (on_failure) + parent dirs (deferred) in the
+   cleanup registry — crash at any point leaves only registry records;
+2. for EVERY table in the colocation group (split points apply to the
+   whole group, keeping co-located joins aligned): read the parent
+   shard's live rows, route them to child ranges by hash token, write
+   child stripes;
+3. ONE catalog save is the atomic commit point: parents out, children in,
+   shard indexes renumbered by token order, colocation shard_count
+   updated;
+4. the cleanup sweep (inline + maintenance daemon) removes parent dirs
+   and manifest entries.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..catalog.catalog import ShardPlacement
+from ..catalog.distribution import (
+    ShardInterval,
+    hash_token,
+    shard_index_for_token_ranges,
+)
+from ..errors import CatalogError
+from ..types import DataType
+from .cleanup import DEFERRED, ON_FAILURE, cleanup_registry_for
+
+
+def split_shard_by_split_points(session, shard_id: int,
+                                split_points: list[int]) -> list[int]:
+    """Split `shard_id`'s token range after each point in split_points.
+    Returns the new shard ids for the named shard's table.  Applies to
+    every colocated table (citus_split_shard_by_split_points semantics).
+    """
+    catalog = session.catalog
+    store = session.store
+    shard = catalog.shards.get(shard_id)
+    if shard is None:
+        raise CatalogError(f"shard {shard_id} does not exist")
+    if shard.min_value is None:
+        raise CatalogError("cannot split a reference/local table shard")
+    points = sorted(set(int(p) for p in split_points))
+    for p in points:
+        if not (shard.min_value <= p < shard.max_value):
+            raise CatalogError(
+                f"split point {p} outside shard range "
+                f"[{shard.min_value}, {shard.max_value})")
+    if not points:
+        raise CatalogError("no valid split points")
+
+    # child ranges: [min..p1], [p1+1..p2], ..., [pk+1..max]
+    los = [shard.min_value] + [p + 1 for p in points]
+    his = points + [shard.max_value]
+
+    meta = session.catalog.table(shard.table_name)
+    group_tables = catalog.colocated_tables(shard.table_name)
+    registry = cleanup_registry_for(session.data_dir)
+    op = registry.start_operation()
+
+    # plan child ids per (table, child range) and register everything
+    # BEFORE writing any data
+    plan: dict[str, dict] = {}
+    for t in group_tables:
+        t_shards = catalog.table_shards(t)
+        parent = next(s for s in t_shards
+                      if s.shard_index == shard.shard_index)
+        child_ids = [catalog.allocate_shard_id() for _ in los]
+        for cid in child_ids:
+            registry.register(op, "shard_dir", t, cid, ON_FAILURE)
+        registry.register(op, "shard_dir", t, parent.shard_id, DEFERRED)
+        plan[t] = {"parent": parent, "children": child_ids}
+
+    # block concurrent writers on every parent shard for the duration
+    # (the reference's metadata write-lock during the split's final phase)
+    from ..transaction.clock import global_clock
+    from ..transaction.locks import lock_manager_for
+
+    locks = lock_manager_for(session.data_dir)
+    lock_txid = global_clock.now()
+    # failure after the in-memory catalog mutated but before the durable
+    # save must NOT let the cleanup sweep think the split committed (it
+    # decides success by looking at the catalog) — snapshot for rollback
+    with catalog._lock:
+        snapshot = catalog.to_json()
+    try:
+        for t, p in sorted((t, plan[t]["parent"].shard_id)
+                           for t in group_tables):
+            locks.acquire(lock_txid, (t, p))
+        for t in group_tables:
+            _rewrite_shard(session, t, plan[t]["parent"],
+                           plan[t]["children"], los, his)
+        # --- atomic commit point: one catalog mutation + save ---
+        with catalog._lock:
+            for t in group_tables:
+                parent = plan[t]["parent"]
+                node_id = catalog.active_placement(parent.shard_id).node_id
+                pids = [p.placement_id
+                        for p in catalog.placements.values()
+                        if p.shard_id == parent.shard_id]
+                for pid in pids:
+                    del catalog.placements[pid]
+                del catalog.shards[parent.shard_id]
+                for cid, lo, hi in zip(plan[t]["children"], los, his):
+                    catalog.shards[cid] = ShardInterval(
+                        cid, t, 0, int(lo), int(hi))
+                    pid = catalog.allocate_placement_id()
+                    catalog.placements[pid] = ShardPlacement(pid, cid,
+                                                             node_id)
+                # renumber shard_index by token order
+                for i, s in enumerate(sorted(
+                        (s for s in catalog.shards.values()
+                         if s.table_name == t),
+                        key=lambda s: s.min_value)):
+                    catalog.shards[s.shard_id] = ShardInterval(
+                        s.shard_id, t, i, s.min_value, s.max_value)
+            group = catalog.colocation_groups[meta.colocation_id]
+            group.shard_count += len(points)
+            catalog._bump()
+        session._save_catalog()
+    except Exception:
+        _restore_catalog(catalog, snapshot)
+        registry.finish_operation(op)
+        registry.sweep(store, catalog)  # children lose: no catalog entry
+        raise
+    finally:
+        locks.release_all(lock_txid)
+    registry.finish_operation(op)
+    registry.sweep(store, catalog)      # parents lose: superseded
+    return plan[shard.table_name]["children"]
+
+
+def _restore_catalog(catalog, snapshot: dict) -> None:
+    """Roll the in-memory catalog back to a pre-mutation snapshot (the
+    persisted catalog was never updated, so this re-aligns memory with
+    disk before the failure sweep consults it)."""
+    from ..catalog.catalog import Catalog
+
+    restored = Catalog.from_json(snapshot)
+    with catalog._lock:
+        catalog.tables = restored.tables
+        catalog.shards = restored.shards
+        catalog.placements = restored.placements
+        catalog.nodes = restored.nodes
+        catalog.colocation_groups = restored.colocation_groups
+        catalog.version = restored.version + 1  # invalidate cached plans
+        catalog._next_shard_id = max(catalog._next_shard_id,
+                                     restored._next_shard_id)
+        catalog._next_placement_id = max(catalog._next_placement_id,
+                                         restored._next_placement_id)
+
+
+def _rewrite_shard(session, table: str, parent: ShardInterval,
+                   child_ids: list[int], los: list[int],
+                   his: list[int]) -> None:
+    """Route the parent shard's live rows into child shards by token."""
+    meta = session.catalog.table(table)
+    store = session.store
+    vals, valid, n = store.read_shard(table, parent.shard_id)
+    if n == 0:
+        return
+    dist_col = meta.distribution_column
+    dt = meta.schema.column(dist_col).dtype
+    if dt == DataType.STRING:
+        d = store.dictionary(table, dist_col)
+        tokens = d.hash_tokens()[vals[dist_col]]
+    else:
+        tokens = hash_token(vals[dist_col])
+    child_idx = shard_index_for_token_ranges(
+        tokens, np.asarray(los, dtype=np.int64))
+    codec = session.settings.get("columnar_compression")
+    level = session.settings.get("columnar_compression_level")
+    chunk_rows = session.settings.get("columnar_chunk_group_row_limit")
+    for i, cid in enumerate(child_ids):
+        mask = child_idx == i
+        if not mask.any():
+            continue
+        sub = {c: vals[c][mask] for c in vals}
+        subv = {c: valid[c][mask] for c in valid}
+        store.append_stripe(table, cid, sub, subv, codec=codec,
+                            level=level, chunk_rows=chunk_rows)
+
+
+def isolate_tenant_to_node(session, table: str, tenant_value) -> int:
+    """Give one tenant (distribution-column value) its own shard — split
+    the containing shard at [token-1, token] (isolate_shards.c analogue).
+    Returns the tenant's new shard id."""
+    catalog = session.catalog
+    meta = catalog.table(table)
+    dist_col = meta.distribution_column
+    if dist_col is None:
+        raise CatalogError(f"table {table!r} is not hash-distributed")
+    dt = meta.schema.column(dist_col).dtype
+    if dt == DataType.STRING:
+        from ..storage.dictionary import string_hash_token
+
+        token = string_hash_token(str(tenant_value))
+    else:
+        token = int(hash_token(np.asarray([tenant_value],
+                                          dtype=dt.numpy_dtype))[0])
+    shard = next((s for s in catalog.table_shards(table)
+                  if s.contains_token(token)), None)
+    if shard is None:
+        raise CatalogError(f"no shard contains token {token}")
+    points = []
+    if shard.min_value < token:
+        points.append(token - 1)
+    if token < shard.max_value:
+        points.append(token)
+    if not points:
+        return shard.shard_id  # already isolated (single-token shard)
+    split_shard_by_split_points(session, shard.shard_id, points)
+    tenant_shard = next(s for s in catalog.table_shards(table)
+                        if s.contains_token(token))
+    return tenant_shard.shard_id

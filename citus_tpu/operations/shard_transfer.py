@@ -36,14 +36,16 @@ def move_shard_placement(catalog: Catalog, store: TableStore,
             sibling = catalog.table_shards(other_name)[shard.shard_index]
             to_move.append(sibling)
     moved = []
-    for s in to_move:
-        placement = catalog.active_placement(s.shard_id)
-        if placement.node_id == target.node_id:
-            continue
-        # deferred cleanup record: old placement lingers as to_delete
-        placement.shard_state = "to_delete"
-        catalog.placements[catalog.allocate_placement_id()] = ShardPlacement(
-            catalog._next_placement_id - 1, s.shard_id, target.node_id)
-        moved.append(s.shard_id)
-    catalog._bump()
+    with catalog._lock:  # background rebalance runs moves off-thread
+        for s in to_move:
+            placement = catalog.active_placement(s.shard_id)
+            if placement.node_id == target.node_id:
+                continue
+            # deferred cleanup record: old placement lingers as to_delete
+            placement.shard_state = "to_delete"
+            pid = catalog.allocate_placement_id()
+            catalog.placements[pid] = ShardPlacement(
+                pid, s.shard_id, target.node_id)
+            moved.append(s.shard_id)
+        catalog._bump()
     return moved

@@ -46,18 +46,22 @@ class Dist:
     cids: columns (equivalence set) the rows are partitioned by.
     shard_count / placement: token-space split + shard→device map; for
     kind='device', shard_count == n_devices and placement is identity.
+    bounds: ascending token-range lower bound per shard — uniform at
+    creation, arbitrary after shard splits; all routing goes through it.
     """
 
     kind: str
     cids: frozenset[str] = frozenset()
     shard_count: int = 0
     placement: tuple[int, ...] = ()
+    bounds: tuple[int, ...] = ()
 
     def colocated_with(self, other: "Dist") -> bool:
         return (self.kind in ("hash", "device")
                 and other.kind in ("hash", "device")
                 and self.shard_count == other.shard_count
-                and self.placement == other.placement)
+                and self.placement == other.placement
+                and self.bounds == other.bounds)
 
 
 # --------------------------------------------------------------------------
@@ -94,6 +98,10 @@ class JoinNode(PlanNode):
     # inner | left | right | full — relative to THIS node's sides ('left'
     # preserves the probe/left side, 'right' the build/right side)
     join_type: str = "inner"
+    # estimated matches per probe row (build_rows / build-key ndv): sizes
+    # the join-output buffer so many-to-many joins don't start at the
+    # PK-FK assumption and burn overflow retries
+    est_expansion: float = 1.0
     # single-side ON predicates of an outer join: gate matching without
     # filtering the preserved side's rows (ON vs WHERE distinction)
     left_match_filter: Optional[ir.BExpr] = None
@@ -179,11 +187,13 @@ class QueryPlan:
 
 class DistributedPlanner:
     def __init__(self, catalog: Catalog, stats: StatsProvider,
-                 n_devices: int, enable_repartition: bool = True):
+                 n_devices: int, enable_repartition: bool = True,
+                 dicts=None):
         self.catalog = catalog
         self.stats = stats
         self.n_devices = n_devices
         self.enable_repartition = enable_repartition
+        self.dicts = dicts  # DictProvider for string routing-token lookup
 
     # -- table dist --------------------------------------------------------
     def _table_dist(self, rel: BoundRel) -> Dist:
@@ -197,11 +207,16 @@ class DistributedPlanner:
         placement = table_placement(self.catalog, rel.table, self.n_devices)
         return Dist("hash",
                     frozenset({rel.cid(meta.distribution_column)}),
-                    len(shards), placement)
+                    len(shards), placement,
+                    tuple(int(s.min_value) for s in shards))
 
     def device_dist(self, cids: frozenset[str]) -> Dist:
+        from ..catalog.distribution import shard_interval_bounds
+
         return Dist("device", cids, self.n_devices,
-                    tuple(range(self.n_devices)))
+                    tuple(range(self.n_devices)),
+                    tuple(lo for lo, _ in
+                          shard_interval_bounds(self.n_devices)))
 
     # -- entry -------------------------------------------------------------
     def plan(self, q: BoundQuery) -> QueryPlan:
@@ -306,7 +321,10 @@ class DistributedPlanner:
         meta = self.catalog.table(rel.table)
         if meta.method != DistributionMethod.HASH:
             return None
-        from ..catalog.distribution import hash_token, shard_index_for_token
+        from ..catalog.distribution import (
+            hash_token,
+            shard_index_for_token_ranges,
+        )
         import numpy as np
 
         dist_cid = rel.cid(meta.distribution_column)
@@ -325,9 +343,25 @@ class DistributedPlanner:
                 values = list(f.values)
             if values is None:
                 continue
-            arr = np.asarray(values, dtype=dtype.numpy_dtype)
-            idx = set(int(i) for i in shard_index_for_token(
-                hash_token(arr), len(self.catalog.table_shards(rel.table))))
+            if dtype == DataType.STRING:
+                # STRING predicates are lowered to dictionary CODES by the
+                # binder; routing tokens come from the dictionary's token
+                # table, NOT from hashing the code itself
+                if self.dicts is None:
+                    continue
+                d = self.dicts.dictionary(rel.table,
+                                          meta.distribution_column)
+                token_table = d.hash_tokens()
+                codes = [int(v) for v in values
+                         if 0 <= int(v) < len(token_table)]
+                if not codes:
+                    return []  # value absent from the table: no shard
+                tokens = token_table[np.asarray(codes, dtype=np.int64)]
+            else:
+                arr = np.asarray(values, dtype=dtype.numpy_dtype)
+                tokens = hash_token(arr)
+            idx = set(int(i) for i in shard_index_for_token_ranges(
+                tokens, self.catalog.shard_mins(rel.table)))
             candidates = idx if candidates is None else (candidates & idx)
         return sorted(candidates) if candidates is not None else None
 
@@ -590,7 +624,8 @@ class DistributedPlanner:
 
         if strategy == "local":
             node.dist = Dist(left.dist.kind, extend_cids(left.dist.cids),
-                             left.dist.shard_count, left.dist.placement)
+                             left.dist.shard_count, left.dist.placement,
+                             left.dist.bounds)
         elif strategy == "broadcast":
             node.dist = left.dist
         elif strategy == "broadcast_left":
@@ -600,13 +635,15 @@ class DistributedPlanner:
                 i for i, lc in enumerate(edge_lcids)
                 if lc & left.dist.cids)
             node.dist = Dist(left.dist.kind, extend_cids(left.dist.cids),
-                             left.dist.shard_count, left.dist.placement)
+                             left.dist.shard_count, left.dist.placement,
+                             left.dist.bounds)
         elif strategy == "repart_left":
             node.repart_key_idx = next(
                 i for i, rc in enumerate(edge_rcids)
                 if rc & right.dist.cids)
             node.dist = Dist(right.dist.kind, extend_cids(right.dist.cids),
-                             right.dist.shard_count, right.dist.placement)
+                             right.dist.shard_count, right.dist.placement,
+                             right.dist.bounds)
         elif strategy == "repart_both":
             if len(edge_lcids) == 1 and \
                     isinstance(left_keys[0], ir.BCol) and \
@@ -635,10 +672,28 @@ class DistributedPlanner:
             else:
                 keep = frozenset()
             node.dist = Dist(node.dist.kind, keep, node.dist.shard_count,
-                             node.dist.placement)
-        node.est_rows = max(left.est_rows, right.est_rows)
+                             node.dist.placement, node.dist.bounds)
+        node.est_expansion = self._estimate_expansion(node)
+        node.est_rows = max(int(node.left.est_rows * node.est_expansion),
+                            left.est_rows, right.est_rows)
         node.out_columns = {**left.out_columns, **right.out_columns}
         return node
+
+    def _estimate_expansion(self, node: JoinNode) -> float:
+        """Matches per probe row ≈ build_rows / ndv(build key) — the
+        pg_statistic-style selectivity estimate for equi-joins; min over
+        edges (every key must match), 1.0 when unknown/PK-like."""
+        best = None
+        build_rows = max(1, node.right.est_rows)
+        for rk in node.right_keys:
+            if not (isinstance(rk, ir.BCol) and rk.table):
+                continue
+            ndv = self.stats.column_ndv(rk.table, rk.column, rk.dtype)
+            if ndv is None or ndv <= 0:
+                continue
+            e = build_rows / ndv
+            best = e if best is None else min(best, e)
+        return max(1.0, best) if best is not None else 1.0
 
     # -- aggregation -------------------------------------------------------
     def _plan_aggregate(self, q: BoundQuery, input_node: PlanNode,

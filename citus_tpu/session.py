@@ -47,7 +47,11 @@ _UDFS = ("create_distributed_table", "create_reference_table",
          "citus_stat_counters", "citus_stat_counters_reset",
          "citus_stat_statements", "citus_stat_statements_reset",
          "citus_stat_tenants", "citus_stat_activity",
-         "get_rebalance_progress")
+         "get_rebalance_progress",
+         "citus_split_shard_by_split_points", "isolate_tenant_to_node",
+         "citus_cleanup_orphaned_resources",
+         "citus_rebalance_start", "citus_rebalance_wait",
+         "citus_job_wait", "citus_job_cancel", "citus_job_list")
 
 
 class _StoreStats(StatsProvider):
@@ -111,7 +115,7 @@ class Session:
 
         self.stats = SessionStats()
         self.executor = Executor(self.catalog, self.store, self.settings,
-                                 self.mesh)
+                                 self.mesh, counters=self.stats.counters)
         # transaction coordinator + shared lock table; interrupted 2PCs
         # from a previous process roll forward/back NOW, before any read
         # (the maintenance-daemon recovery pass at backend start;
@@ -122,6 +126,20 @@ class Session:
         self.txn_manager = TransactionManager(self.store, self.data_dir)
         self.locks = lock_manager_for(self.data_dir)
         self.txn_manager.recover()
+        # crash-recovery sweep: half-finished splits/moves resolve against
+        # the catalog (operations/cleanup.py; ref: shard_cleaner.c)
+        from .operations.cleanup import cleanup_registry_for
+
+        cleanup_registry_for(self.data_dir).sweep(self.store, self.catalog)
+        # background services: job runner (pg_dist_background_task
+        # executors) + maintenance daemon (2PC recovery, deferred cleanup,
+        # deadlock checks — utils/maintenanced.c:460)
+        from .background import BackgroundJobRunner, MaintenanceDaemon
+
+        self.jobs = BackgroundJobRunner(
+            self.settings.get("max_background_task_executors"))
+        self.maintenance = MaintenanceDaemon(self)
+        self.maintenance.start()
 
     # -- public API --------------------------------------------------------
     def execute(self, sql: str):
@@ -197,6 +215,8 @@ class Session:
         self._save_catalog()
 
     def close(self):
+        self.maintenance.stop()
+        self.jobs.shutdown()
         self._save_catalog()
 
     # -- statement dispatch ------------------------------------------------
@@ -270,8 +290,11 @@ class Session:
         elif e.name == "rebalance_table_shards":
             from .operations.rebalancer import rebalance_table_shards
 
-            moves = rebalance_table_shards(self.catalog, self.store,
-                                           progress=self.stats.progress)
+            moves = rebalance_table_shards(
+                self.catalog, self.store,
+                self.settings.get("rebalance_threshold"),
+                self.settings.get("rebalance_improvement_threshold"),
+                progress=self.stats.progress)
             self._save_catalog()
             return ResultSet(["moves"], {"moves": [len(moves)]}, 1)
         elif e.name == "citus_move_shard_placement":
@@ -280,6 +303,46 @@ class Session:
             move_shard_placement(self.catalog, self.store, int(args[0]),
                                  str(args[1]))
             self._save_catalog()
+        elif e.name == "citus_split_shard_by_split_points":
+            from .operations.shard_split import split_shard_by_split_points
+
+            points = [int(p) for p in str(args[1]).split(",")]
+            children = split_shard_by_split_points(self, int(args[0]),
+                                                   points)
+            return ResultSet(["new_shard_ids"],
+                             {"new_shard_ids":
+                              [",".join(map(str, children))]}, 1)
+        elif e.name == "isolate_tenant_to_node":
+            from .operations.shard_split import isolate_tenant_to_node
+
+            tenant = args[1]
+            new_shard = isolate_tenant_to_node(self, str(args[0]), tenant)
+            return ResultSet(["shard_id"], {"shard_id": [new_shard]}, 1)
+        elif e.name == "citus_cleanup_orphaned_resources":
+            from .operations.cleanup import cleanup_registry_for
+
+            n = cleanup_registry_for(self.data_dir).sweep(self.store,
+                                                           self.catalog)
+            return ResultSet(["cleaned"], {"cleaned": [n]}, 1)
+        elif e.name == "citus_rebalance_start":
+            job_id = self._start_background_rebalance()
+            return ResultSet(["job_id"], {"job_id": [job_id]}, 1)
+        elif e.name in ("citus_rebalance_wait", "citus_job_wait"):
+            job_id = int(args[0]) if args else self._last_rebalance_job
+            if job_id == 0:  # nothing was scheduled (already balanced)
+                return ResultSet(["status"], {"status": ["done"]}, 1)
+            status = self.jobs.wait(job_id)
+            return ResultSet(["status"], {"status": [status.value]}, 1)
+        elif e.name == "citus_job_cancel":
+            self.jobs.cancel(int(args[0]))
+        elif e.name == "citus_job_list":
+            jobs = self.jobs.jobs()
+            return ResultSet(
+                ["job_id", "description", "status", "tasks"],
+                {"job_id": [j.job_id for j in jobs],
+                 "description": [j.description for j in jobs],
+                 "status": [j.status.value for j in jobs],
+                 "tasks": [len(j.tasks) for j in jobs]}, len(jobs))
         elif e.name == "citus_get_node_clock":
             from .transaction.clock import global_clock
 
@@ -330,6 +393,44 @@ class Session:
                  "total": [m.total_steps for m in mons],
                  "detail": [m.detail for m in mons]}, len(mons))
         return ResultSet(["ok"], {"ok": [True]}, 1)
+
+    _last_rebalance_job = 0
+
+    def _start_background_rebalance(self) -> int:
+        """citus_rebalance_start analogue: plan the moves, run them as a
+        dependency-chained background job with live progress
+        (utils/background_jobs.c + shard_rebalancer.c:1165)."""
+        from .operations.rebalancer import plan_rebalance
+        from .operations.shard_transfer import move_shard_placement
+
+        moves = plan_rebalance(
+            self.catalog, self.store,
+            self.settings.get("rebalance_threshold"),
+            self.settings.get("rebalance_improvement_threshold"))
+        if not moves:
+            return 0
+        mon = self.stats.progress.create("rebalance", "background",
+                                         len(moves))
+
+        def make_move(mv):
+            def run():
+                target = self.catalog.nodes[mv.target_node]
+                move_shard_placement(self.catalog, self.store,
+                                     mv.shard_id, target.name)
+                self._save_catalog()
+                mon.advance(1, f"moved shard {mv.shard_id}")
+            return run
+
+        tasks = []
+        for i, mv in enumerate(moves):
+            # chain moves: catalog mutations serialize (the reference
+            # parallelizes across nodes under per-node caps)
+            tasks.append((make_move(mv), f"move shard {mv.shard_id}",
+                          [i - 1] if i else []))
+        tasks.append((mon.finish, "finalize", [len(moves) - 1]))
+        job_id = self.jobs.submit_job("rebalance", tasks)
+        self._last_rebalance_job = job_id
+        return job_id
 
     # -- DDL ---------------------------------------------------------------
     def _execute_create_table(self, stmt: ast.CreateTable):
@@ -522,8 +623,15 @@ class Session:
         bound = binder.bind_select(sel)
         planner = DistributedPlanner(
             self.catalog, _StoreStats(self.store), self.n_devices,
-            self.settings.get("enable_repartition_joins"))
-        return planner.plan(bound), cleanup
+            self.settings.get("enable_repartition_joins"),
+            dicts=_StoreDicts(self.store))
+        plan = planner.plan(bound)
+        if self.settings.get("log_distributed_plans"):
+            import sys
+
+            for line in format_plan(plan, self.catalog):
+                print(line, file=sys.stderr)
+        return plan, cleanup
 
     def _execute_explain(self, stmt: ast.Explain):
         from .executor.runner import ResultSet
@@ -536,6 +644,10 @@ class Session:
             if stmt.analyze:
                 import time
 
+                from .stats import counters as sc
+
+                skipped0 = self.stats.counters.snapshot().get(
+                    sc.CHUNKS_SKIPPED, 0)
                 t0 = time.perf_counter()
                 result = self.executor.execute_plan(plan)
                 elapsed = time.perf_counter() - t0
@@ -543,6 +655,10 @@ class Session:
                 lines.append(f"Rows: {result.row_count}"
                              + (f" (capacity retries: {result.retries})"
                                 if result.retries else ""))
+                skipped = self.stats.counters.snapshot().get(
+                    sc.CHUNKS_SKIPPED, 0) - skipped0
+                if skipped:
+                    lines.append(f"Chunks Skipped: {skipped}")
                 if result.device_rows_scanned:
                     lines.append("Device Rows Scanned: "
                                  f"{result.device_rows_scanned}")

@@ -54,10 +54,14 @@ class ChunkStats:
 
 def _stats_for(values: np.ndarray, valid: np.ndarray, dtype: DataType) -> ChunkStats:
     null_count = int((~valid).sum())
-    if dtype == DataType.STRING or null_count == len(values):
-        # code ordering is insertion order — min/max not meaningful
+    if null_count == len(values):
         return ChunkStats(None, None, null_count)
     vv = values[valid]
+    if dtype == DataType.STRING:
+        # dictionary CODE range: insertion order isn't value order, but
+        # containment checks (equality/IN over codes) are still exact —
+        # a chunk whose code range excludes the target can be skipped
+        return ChunkStats(int(vv.min()), int(vv.max()), null_count)
     if dtype == DataType.BOOL:
         return ChunkStats(int(vv.min()), int(vv.max()), null_count)
     mn, mx = vv.min(), vv.max()

@@ -172,6 +172,9 @@ class TableStore:
         exists on disk but is invisible until `commit_pending` flips the
         manifest — the write/visibility split the transaction layer uses.
         Returns the pending-stripe record."""
+        from ..utils.faultinjection import fault_point
+
+        fault_point("store.append_stripe")
         meta = self.catalog.table(table)
         schema_cols = [(c.name, c.dtype) for c in meta.schema.columns]
         with self._write_lock(table), self._lock:
@@ -259,6 +262,9 @@ class TableStore:
         visible by a single manifest write.  Delete-mask files are
         versioned, never overwritten in place, so a crash before the
         manifest flip leaves only orphan files."""
+        from ..utils.faultinjection import fault_point
+
+        fault_point("store.apply_dml")
         with self._write_lock(table), self._lock:
             self.save_dictionaries(table)
             man = self._reload_manifest_locked(table)
@@ -307,6 +313,16 @@ class TableStore:
                     os.unlink(path)
                 except OSError:
                     pass
+
+    def remove_shard_records(self, table: str, shard_id: int) -> None:
+        """Drop a shard's manifest entries (split/cleanup: the shard's
+        rows now live in successor shards)."""
+        with self._write_lock(table), self._lock:
+            man = self._reload_manifest_locked(table)
+            if str(shard_id) in man["shards"]:
+                del man["shards"][str(shard_id)]
+                self._save_manifest(table)
+                self.bump_data_version(table)
 
     def shard_stripe_records(self, table: str, shard_id: int) -> list[dict]:
         man = self.manifest(table)

@@ -37,6 +37,21 @@ from ..errors import ExecutionError
 from .clock import global_clock
 
 
+# per-txnlog-dir commit/recovery mutex: the maintenance daemon's periodic
+# recovery pass must never reap a txn directory an in-flight COMMIT (from
+# this or any session on the data_dir) is still writing
+_txnlog_locks: dict[str, threading.Lock] = {}
+_txnlog_locks_mu = threading.Lock()
+
+
+def _txnlog_lock(log_dir: str) -> threading.Lock:
+    key = os.path.abspath(log_dir)
+    with _txnlog_locks_mu:
+        if key not in _txnlog_locks:
+            _txnlog_locks[key] = threading.Lock()
+        return _txnlog_locks[key]
+
+
 class Overlay:
     """Uncommitted effects folded into TableStore reads."""
 
@@ -117,8 +132,15 @@ class TransactionManager:
         return os.path.join(self.log_dir, f"txn_{txid}")
 
     def _commit_staged(self, txn: Transaction) -> None:
+        with _txnlog_lock(self.log_dir):
+            self._commit_staged_locked(txn)
+
+    def _commit_staged_locked(self, txn: Transaction) -> None:
+        from ..utils.faultinjection import fault_point
+
         tdir = self._txn_dir(txn.txid)
         os.makedirs(tdir, exist_ok=True)
+        fault_point("txn.prepare")
         # 1. PREPARE: persist staged masks + the effect list
         effects: dict[str, dict] = {}
         for table in sorted(txn.tables):
@@ -143,6 +165,7 @@ class TransactionManager:
             os.fsync(f.fileno())
         os.replace(tmp, prepare_path)
         _fsync_dir(tdir)
+        fault_point("txn.commit_record")  # prepared but no commit record
         # 2. commit record — the atomic commit point.  The directory fsyncs
         # make the renames themselves durable (the WAL-durability the
         # reference gets from the pg_dist_transaction INSERT): without
@@ -155,6 +178,7 @@ class TransactionManager:
         os.replace(commit_path + ".tmp", commit_path)
         _fsync_dir(tdir)
         _fsync_dir(self.log_dir)
+        fault_point("txn.apply")  # commit record durable, not yet applied
         # 3. apply per table (each manifest flip is atomic; replay-safe)
         _apply_effects(self.store, tdir, effects)
         # 4. cleanup
@@ -188,10 +212,16 @@ def _apply_effects(store, tdir: str, effects: dict) -> None:
 
 def recover_transactions(store, log_dir: str) -> tuple[int, int]:
     """The RecoverTwoPhaseCommits analogue: commit record present → roll
-    forward (idempotent apply); absent → discard staged files."""
-    committed = discarded = 0
+    forward (idempotent apply); absent → discard staged files.  Serialized
+    against in-flight commits on the same txnlog (see _txnlog_lock)."""
     if not os.path.isdir(log_dir):
         return 0, 0
+    with _txnlog_lock(log_dir):
+        return _recover_locked(store, log_dir)
+
+
+def _recover_locked(store, log_dir: str) -> tuple[int, int]:
+    committed = discarded = 0
     for name in sorted(os.listdir(log_dir)):
         tdir = os.path.join(log_dir, name)
         if not name.startswith("txn_") or not os.path.isdir(tdir):

@@ -1,0 +1,62 @@
+"""Fault-injection points for storage and transaction boundaries.
+
+The reference injects failures by interposing mitmproxy between
+coordinator and worker and killing/delaying traffic at named moments
+(`citus.mitmproxy('conn.onQuery(query="COMMIT").kill()')` —
+/root/reference/src/test/regress/mitmscripts/README.md:1-60, fluent.py).
+Single-controller mapping: the process boundaries to break are the
+storage writes and the 2PC steps, so named fault points sit at those
+seams and tests arm them:
+
+    with inject("txn.commit_record", after=0):
+        session.execute("COMMIT")      # dies right before the record
+
+Armed points raise InjectedFault after `after` passes through; the
+default (unarmed) cost is a dict lookup.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+
+
+class InjectedFault(Exception):
+    """Raised at an armed fault point (the 'connection killed' analogue)."""
+
+
+_lock = threading.Lock()
+_armed: dict[str, dict] = {}
+
+
+def fault_point(name: str) -> None:
+    """Called at instrumented seams; raises when armed and triggered."""
+    if not _armed:
+        return
+    with _lock:
+        spec = _armed.get(name)
+        if spec is None:
+            return
+        if spec["after"] > 0:
+            spec["after"] -= 1
+            return
+        if spec.get("once", True):
+            del _armed[name]
+    raise InjectedFault(f"injected fault at {name!r}")
+
+
+@contextlib.contextmanager
+def inject(name: str, after: int = 0, once: bool = True):
+    """Arm `name` to raise after `after` successful passes."""
+    with _lock:
+        _armed[name] = {"after": after, "once": once}
+    try:
+        yield
+    finally:
+        with _lock:
+            _armed.pop(name, None)
+
+
+def reset() -> None:
+    with _lock:
+        _armed.clear()

@@ -1,0 +1,194 @@
+"""Fault injection at storage/transaction seams + pairwise concurrency.
+
+The reference's failure harness interposes mitmproxy between coordinator
+and workers and kills traffic at named moments
+(src/test/regress/mitmscripts/README.md:1-60); its isolation suite runs
+operations pairwise (125 specs under src/test/regress/spec/).  Here the
+seams are named fault points (utils/faultinjection.py) and the pairwise
+ops run as threads against one data_dir.
+"""
+
+import threading
+
+import pytest
+
+import citus_tpu
+from citus_tpu.utils.faultinjection import InjectedFault, inject, reset
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    reset()
+    yield
+    reset()
+
+
+def setup_accounts(sess, rows=8):
+    sess.execute("CREATE TABLE acc (id INT, bal INT)")
+    sess.execute("SELECT create_distributed_table('acc', 'id', 4)")
+    sess.execute("INSERT INTO acc VALUES " + ", ".join(
+        f"({i}, {100 * (i + 1)})" for i in range(rows)))
+
+
+def totals(sess):
+    r = sess.execute("SELECT count(*), sum(bal) FROM acc").rows()[0]
+    return int(r[0]), int(r[1])
+
+
+class TestInjectedCrashes:
+    def test_crash_before_commit_record_rolls_back(self, tmp_data_dir):
+        sess = citus_tpu.connect(data_dir=tmp_data_dir)
+        setup_accounts(sess)
+        sess.execute("BEGIN")
+        sess.execute("UPDATE acc SET bal = 0 WHERE id = 1")
+        with inject("txn.commit_record"):
+            with pytest.raises(InjectedFault):
+                sess.execute("COMMIT")
+        # prepared but never committed → recovery rolls BACK
+        fresh = citus_tpu.connect(data_dir=tmp_data_dir)
+        assert totals(fresh) == (8, 3600)
+
+    def test_crash_after_commit_record_rolls_forward(self, tmp_data_dir):
+        sess = citus_tpu.connect(data_dir=tmp_data_dir)
+        setup_accounts(sess)
+        sess.execute("BEGIN")
+        sess.execute("UPDATE acc SET bal = 0 WHERE id = 1")
+        with inject("txn.apply"):
+            with pytest.raises(InjectedFault):
+                sess.execute("COMMIT")
+        # commit record durable → recovery rolls FORWARD
+        fresh = citus_tpu.connect(data_dir=tmp_data_dir)
+        assert totals(fresh) == (8, 3600 - 200)
+
+    def test_ingest_failure_after_n_stripes_leaks_nothing(self,
+                                                          tmp_data_dir):
+        sess = citus_tpu.connect(data_dir=tmp_data_dir)
+        sess.execute("CREATE TABLE t (id INT, v INT)")
+        sess.execute("SELECT create_distributed_table('t', 'id', 4)")
+        vals = ", ".join(f"({i}, {i})" for i in range(200))
+        # fail on the 3rd shard's stripe write mid-INSERT
+        with inject("store.append_stripe", after=2):
+            with pytest.raises(InjectedFault):
+                sess.execute(f"INSERT INTO t VALUES {vals}")
+        assert int(sess.execute(
+            "SELECT count(*) FROM t").rows()[0][0]) == 0
+        # earlier shards' orphan stripe files were discarded
+        import glob
+        import os
+
+        files = glob.glob(os.path.join(tmp_data_dir, "tables", "t",
+                                       "shard_*", "*.ctps"))
+        assert files == []
+        # the table still works afterward
+        sess.execute(f"INSERT INTO t VALUES {vals}")
+        assert int(sess.execute(
+            "SELECT count(*) FROM t").rows()[0][0]) == 200
+
+    def test_dml_apply_failure_keeps_old_state(self, tmp_data_dir):
+        sess = citus_tpu.connect(data_dir=tmp_data_dir)
+        setup_accounts(sess)
+        with inject("store.apply_dml"):
+            with pytest.raises(InjectedFault):
+                sess.execute("UPDATE acc SET bal = 0")
+        assert totals(sess) == (8, 3600)
+        sess.execute("UPDATE acc SET bal = bal + 1")
+        assert totals(sess) == (8, 3608)
+
+
+class TestPairwiseConcurrency:
+    def test_ingest_vs_move(self, tmp_data_dir):
+        s1 = citus_tpu.connect(data_dir=tmp_data_dir, n_devices=1)
+        s1.execute("CREATE TABLE t (id INT, v INT)")
+        s1.execute("SELECT create_distributed_table('t', 'id', 4)")
+        s1.execute("SELECT citus_add_node('spare:1')")
+        errs = []
+        done = threading.Event()
+
+        def ingest():
+            try:
+                for b in range(10):
+                    vals = ", ".join(f"({b * 50 + i}, 1)" for i in range(50))
+                    s1.execute(f"INSERT INTO t VALUES {vals}")
+            except Exception as e:  # pragma: no cover
+                errs.append(e)
+            finally:
+                done.set()
+
+        def mover():
+            s2 = citus_tpu.connect(data_dir=tmp_data_dir, n_devices=1)
+            while not done.is_set():
+                for s in list(s2.catalog.table_shards("t")):
+                    try:
+                        target = ("spare:1" if s2.catalog.active_placement(
+                            s.shard_id).node_id == 1 else "device:0")
+                        s2.execute(
+                            f"SELECT citus_move_shard_placement("
+                            f"{s.shard_id}, '{target}')")
+                    except Exception as e:  # pragma: no cover
+                        errs.append(e)
+                        return
+
+        t1 = threading.Thread(target=ingest)
+        t2 = threading.Thread(target=mover)
+        t1.start(); t2.start(); t1.join(30); t2.join(30)
+        assert not errs
+        assert int(s1.execute(
+            "SELECT count(*) FROM t").rows()[0][0]) == 500
+
+    def test_ingest_vs_split(self, tmp_data_dir):
+        s1 = citus_tpu.connect(data_dir=tmp_data_dir, n_devices=1)
+        s1.execute("CREATE TABLE t (id INT, v INT)")
+        s1.execute("SELECT create_distributed_table('t', 'id', 4)")
+        errs = []
+        done = threading.Event()
+
+        def ingest():
+            try:
+                for b in range(10):
+                    vals = ", ".join(f"({b * 40 + i}, 1)" for i in range(40))
+                    s1.execute(f"INSERT INTO t VALUES {vals}")
+            except Exception as e:  # pragma: no cover
+                errs.append(e)
+            finally:
+                done.set()
+
+        def splitter():
+            from citus_tpu.operations.shard_split import (
+                split_shard_by_split_points,
+            )
+
+            n = 0
+            while not done.is_set() and n < 3:
+                shards = s1.catalog.table_shards("t")
+                widest = max(shards,
+                             key=lambda s: s.max_value - s.min_value)
+                mid = (widest.min_value + widest.max_value) // 2
+                try:
+                    split_shard_by_split_points(s1, widest.shard_id, [mid])
+                    n += 1
+                except Exception as e:  # pragma: no cover
+                    errs.append(e)
+                    return
+
+        t1 = threading.Thread(target=ingest)
+        t2 = threading.Thread(target=splitter)
+        t1.start(); t2.start(); t1.join(60); t2.join(60)
+        assert not errs
+        assert int(s1.execute(
+            "SELECT count(*) FROM t").rows()[0][0]) == 400
+        assert len(s1.catalog.table_shards("t")) >= 5
+
+    def test_update_vs_background_rebalance(self, tmp_data_dir):
+        sess = citus_tpu.connect(data_dir=tmp_data_dir, n_devices=1,
+                                 rebalance_improvement_threshold=0.05)
+        setup_accounts(sess, rows=40)
+        sess.execute("SELECT citus_add_node('spare:1')")
+        r = sess.execute("SELECT citus_rebalance_start()")
+        job_id = int(r.rows()[0][0])
+        for _ in range(5):
+            sess.execute("UPDATE acc SET bal = bal + 1")
+        if job_id:
+            sess.execute(f"SELECT citus_job_wait({job_id})")
+        count, total = totals(sess)
+        assert count == 40
+        assert total == sum(100 * (i + 1) for i in range(40)) + 5 * 40

@@ -1,0 +1,183 @@
+"""Shard split, tenant isolation, and deferred cleanup.
+
+Reference: operations/shard_split.c + citus_split_shard_by_split_points.c
+(online split), operations/isolate_shards.c (tenant isolation),
+operations/shard_cleaner.c (pg_dist_cleanup deferred cleanup).
+"""
+
+import glob
+import os
+
+import numpy as np
+import pytest
+
+import citus_tpu
+from citus_tpu.catalog.distribution import (
+    INT32_MAX,
+    INT32_MIN,
+    hash_token,
+)
+from citus_tpu.errors import CatalogError
+from citus_tpu.operations.cleanup import CleanupRegistry
+
+
+def make_data(sess, rows=400, shards=4):
+    sess.execute("CREATE TABLE t (id INT, grp INT, v FLOAT8)")
+    sess.execute(f"SELECT create_distributed_table('t', 'id', {shards})")
+    sess.execute("CREATE TABLE s (id INT, w INT)")
+    sess.execute(
+        "SELECT create_distributed_table('s', 'id', 4, 't')"
+        .replace(", 4,", f", {shards},"))
+    vals = ", ".join(f"({i}, {i % 10}, {i}.5)" for i in range(rows))
+    sess.execute(f"INSERT INTO t VALUES {vals}")
+    svals = ", ".join(f"({i}, {i * 2})" for i in range(0, rows, 2))
+    sess.execute(f"INSERT INTO s VALUES {svals}")
+
+
+def table_state(sess):
+    r1 = sess.execute("SELECT count(*), sum(v) FROM t").rows()[0]
+    r2 = sess.execute(
+        "SELECT count(*) FROM t, s WHERE t.id = s.id").rows()[0]
+    return int(r1[0]), round(float(r1[1]), 2), int(r2[0])
+
+
+class TestShardSplit:
+    def test_split_preserves_data_and_colocation(self, tmp_data_dir):
+        sess = citus_tpu.connect(data_dir=tmp_data_dir, n_devices=4)
+        make_data(sess)
+        before = table_state(sess)
+        shard = sess.catalog.table_shards("t")[1]
+        mid = (shard.min_value + shard.max_value) // 2
+        r = sess.execute(
+            f"SELECT citus_split_shard_by_split_points({shard.shard_id}, "
+            f"'{mid}')")
+        children = [int(x) for x in r.rows()[0][0].split(",")]
+        assert len(children) == 2
+        # the colocation group grew together
+        assert len(sess.catalog.table_shards("t")) == 5
+        assert len(sess.catalog.table_shards("s")) == 5
+        # bounds are contiguous and renumbered
+        mins = sess.catalog.shard_mins("t")
+        assert mins[0] == INT32_MIN
+        assert list(mins) == sorted(mins)
+        shards = sess.catalog.table_shards("t")
+        for a, b in zip(shards, shards[1:]):
+            assert a.max_value + 1 == b.min_value
+        assert shards[-1].max_value == INT32_MAX
+        # data intact, colocated join still correct
+        assert table_state(sess) == before
+        # queries route correctly post-split (pruning by dist col)
+        one = sess.execute("SELECT v FROM t WHERE id = 123").rows()
+        assert one == [(123.5,)]
+
+    def test_split_survives_reopen(self, tmp_data_dir):
+        sess = citus_tpu.connect(data_dir=tmp_data_dir, n_devices=4)
+        make_data(sess, rows=200)
+        before = table_state(sess)
+        shard = sess.catalog.table_shards("t")[0]
+        mid = (shard.min_value + shard.max_value) // 2
+        sess.execute(
+            f"SELECT citus_split_shard_by_split_points({shard.shard_id}, "
+            f"'{mid}')")
+        sess.close()
+        sess2 = citus_tpu.connect(data_dir=tmp_data_dir, n_devices=4)
+        assert table_state(sess2) == before
+        assert len(sess2.catalog.table_shards("t")) == 5
+
+    def test_parent_dir_cleaned_after_split(self, tmp_data_dir):
+        sess = citus_tpu.connect(data_dir=tmp_data_dir, n_devices=4)
+        make_data(sess, rows=100)
+        shard = sess.catalog.table_shards("t")[2]
+        parent_dir = os.path.join(tmp_data_dir, "tables", "t",
+                                  f"shard_{shard.shard_id}")
+        assert os.path.isdir(parent_dir)
+        mid = (shard.min_value + shard.max_value) // 2
+        sess.execute(
+            f"SELECT citus_split_shard_by_split_points({shard.shard_id}, "
+            f"'{mid}')")
+        # inline sweep removed the superseded parent dir + manifest rows
+        assert not os.path.isdir(parent_dir)
+        man = sess.store.manifest("t")
+        assert str(shard.shard_id) not in man["shards"]
+
+    def test_invalid_split_points(self, tmp_data_dir):
+        sess = citus_tpu.connect(data_dir=tmp_data_dir, n_devices=4)
+        make_data(sess, rows=50)
+        shard = sess.catalog.table_shards("t")[0]
+        with pytest.raises(CatalogError):
+            sess.execute(
+                f"SELECT citus_split_shard_by_split_points("
+                f"{shard.shard_id}, '{shard.max_value}')")
+        with pytest.raises(CatalogError):
+            sess.execute(
+                "SELECT citus_split_shard_by_split_points(999999, '0')")
+
+    def test_crash_mid_split_recovers(self, tmp_data_dir, monkeypatch):
+        sess = citus_tpu.connect(data_dir=tmp_data_dir, n_devices=4)
+        make_data(sess, rows=100)
+        before = table_state(sess)
+        shard = sess.catalog.table_shards("t")[1]
+        mid = (shard.min_value + shard.max_value) // 2
+
+        import citus_tpu.operations.shard_split as split_mod
+
+        calls = {"n": 0}
+        orig = split_mod._rewrite_shard
+
+        def crash_on_second(session, table, parent, child_ids, los, his):
+            calls["n"] += 1
+            if calls["n"] == 2:
+                raise RuntimeError("simulated crash mid-split")
+            return orig(session, table, parent, child_ids, los, his)
+
+        monkeypatch.setattr(split_mod, "_rewrite_shard", crash_on_second)
+        with pytest.raises(RuntimeError):
+            split_mod.split_shard_by_split_points(sess, shard.shard_id,
+                                                  [mid])
+        monkeypatch.undo()
+        # catalog untouched; children cleaned; data consistent
+        assert len(sess.catalog.table_shards("t")) == 4
+        assert table_state(sess) == before
+        assert CleanupRegistry(tmp_data_dir).pending() == []
+        # a fresh session also sees a consistent state
+        sess2 = citus_tpu.connect(data_dir=tmp_data_dir, n_devices=4)
+        assert table_state(sess2) == before
+
+
+class TestTenantIsolation:
+    def test_isolate_tenant(self, tmp_data_dir):
+        sess = citus_tpu.connect(data_dir=tmp_data_dir, n_devices=4)
+        make_data(sess, rows=300)
+        before = table_state(sess)
+        r = sess.execute("SELECT isolate_tenant_to_node('t', 42)")
+        tenant_shard = int(r.rows()[0][0])
+        # the tenant's shard covers exactly its token (up to space edges)
+        tok = int(hash_token(np.asarray([42], dtype=np.int32))[0])
+        s = sess.catalog.shards[tenant_shard]
+        assert s.contains_token(tok)
+        assert (s.min_value == tok or s.min_value == INT32_MIN)
+        assert (s.max_value == tok or s.max_value == INT32_MAX)
+        # all data survives; tenant rows still query correctly
+        assert table_state(sess) == before
+        rows = sess.execute("SELECT v FROM t WHERE id = 42").rows()
+        assert rows == [(42.5,)]
+        # only tenant-token rows live in the tenant shard
+        vals, _valid, n = sess.store.read_shard("t", tenant_shard, ["id"])
+        toks = hash_token(vals["id"])
+        assert all(s.contains_token(int(x)) for x in toks)
+
+    def test_isolate_in_string_distributed_table(self, tmp_data_dir):
+        sess = citus_tpu.connect(data_dir=tmp_data_dir, n_devices=4)
+        sess.execute("CREATE TABLE logs (tenant TEXT, n INT)")
+        sess.execute("SELECT create_distributed_table('logs', 'tenant', 4)")
+        sess.execute("INSERT INTO logs VALUES " + ", ".join(
+            f"('tenant{i % 7}', {i})" for i in range(100)))
+        before = sess.execute(
+            "SELECT count(*), sum(n) FROM logs").rows()[0]
+        sess.execute("SELECT isolate_tenant_to_node('logs', 'tenant3')")
+        after = sess.execute(
+            "SELECT count(*), sum(n) FROM logs").rows()[0]
+        assert before == after
+        per_tenant = sess.execute(
+            "SELECT count(*) FROM logs WHERE tenant = 'tenant3'").rows()
+        assert int(per_tenant[0][0]) == 100 // 7 + (1 if 3 < 100 % 7 else 0)
