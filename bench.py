@@ -1,16 +1,23 @@
-"""Benchmark driver: TPC-H Q1 scan-aggregate throughput on one chip.
+"""Benchmark driver: the five BASELINE.json configs on one chip.
 
-Prints exactly ONE JSON line:
-    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+Prints one JSON line per config; the LAST line is the headline metric
+(TPC-H Q1 scan-aggregate throughput), matching the driver contract of a
+final `{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}` line.
 
-Baseline: the reference's only published scan-aggregate number — the
+Baseline yardstick: the reference's only published absolute number — the
 columnar engine aggregating 75M rows in 16 s (≈4.69M rows/s) on a 2-vCPU
-Azure VM (/root/reference/src/backend/columnar/README.md:303-321, the "27×
-vs row tables" measurement).  Q1 is the same shape of work (scan + filter +
-grouped aggregation over lineitem) so rows/sec is directly comparable.
+Azure VM (/root/reference/src/backend/columnar/README.md:303-321).  Every
+config reports rows-processed/sec against that scan rate.
 
-Env knobs: BENCH_SF (scale factor, default 0.2), BENCH_REPEATS (default 3),
-BENCH_QUERY (default Q1).
+Configs (BASELINE.json):
+  1. TPC-H Q1 scan + grouped aggregate over lineitem      [headline]
+  2. co-located hash join (orders ⋈ lineitem on orderkey)
+  3. single-repartition join (customer ⋈ orders on custkey)
+  4. dual-repartition join + global aggregate (psum combine)
+  5. TPC-H Q3 multi-join (repartition + colocated + grouped aggregate)
+
+Env knobs: BENCH_SF (default 1.0), BENCH_REPEATS (default 3),
+BENCH_ONLY (comma list of config names to run).
 """
 
 from __future__ import annotations
@@ -18,46 +25,98 @@ from __future__ import annotations
 import json
 import os
 import shutil
-import sys
 import tempfile
 import time
 
 BASELINE_ROWS_PER_SEC = 75_000_000 / 16.0  # reference columnar agg scan
 
 
+def bench_query(sess, sql: str, rows_processed: int, repeats: int):
+    sess.execute(sql)  # warmup: compile + populate caches
+    best = float("inf")
+    result = None
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        result = sess.execute(sql)
+        best = min(best, time.perf_counter() - t0)
+    assert result is not None and result.row_count > 0
+    return rows_processed / best, best
+
+
 def main() -> None:
-    sf = float(os.environ.get("BENCH_SF", "0.2"))
+    sf = float(os.environ.get("BENCH_SF", "1.0"))
     repeats = int(os.environ.get("BENCH_REPEATS", "3"))
-    qname = os.environ.get("BENCH_QUERY", "Q1")
+    only = os.environ.get("BENCH_ONLY")
+    only = set(only.split(",")) if only else None
 
     from citus_tpu.session import Session
     from citus_tpu.ingest.tpch import QUERIES, load_into_session
 
     data_dir = tempfile.mkdtemp(prefix="citus_tpu_bench_")
+    lines = []
     try:
         sess = Session(data_dir=data_dir)
-        counts = load_into_session(sess, sf=sf, seed=0)
-        lineitem_rows = sess.store.table_row_count("lineitem")
-        sql = QUERIES[qname]
+        load_into_session(sess, sf=sf, seed=0)
+        n_li = sess.store.table_row_count("lineitem")
+        n_ord = sess.store.table_row_count("orders")
+        n_cust = sess.store.table_row_count("customer")
 
-        # warmup: compile + populate host caches
-        sess.execute(sql)
-        best = float("inf")
-        for _ in range(repeats):
-            t0 = time.perf_counter()
-            result = sess.execute(sql)
-            dt = time.perf_counter() - t0
-            best = min(best, dt)
-        assert result.row_count > 0
-        rows_per_sec = lineitem_rows / best
-        print(json.dumps({
-            "metric": f"tpch_{qname.lower()}_rows_per_sec",
-            "value": round(rows_per_sec, 1),
-            "unit": "rows/s",
-            "vs_baseline": round(rows_per_sec / BASELINE_ROWS_PER_SEC, 3),
-        }))
+        configs = [
+            # (name, sql, rows processed by the query)
+            ("colocated_join_rows_per_sec",
+             "select count(*), sum(l_extendedprice) from orders, lineitem "
+             "where o_orderkey = l_orderkey",
+             n_ord + n_li),
+            ("single_repartition_join_rows_per_sec",
+             "select count(*), sum(o_totalprice) from customer, orders "
+             "where c_custkey = o_custkey",
+             n_cust + n_ord),
+            ("dual_repartition_join_rows_per_sec",
+             "select count(*) from orders, lineitem "
+             "where o_custkey = l_suppkey",
+             n_ord + n_li),
+            ("tpch_q3_rows_per_sec", QUERIES["Q3"], n_cust + n_ord + n_li),
+            ("tpch_q1_rows_per_sec", QUERIES["Q1"], n_li),  # headline LAST
+        ]
+        for name, sql, rows in configs:
+            if only is not None and name not in only:
+                continue
+            rate, best = bench_query(sess, sql, rows, repeats)
+            lines.append({
+                "metric": name,
+                "value": round(rate, 1),
+                "unit": "rows/s",
+                "vs_baseline": round(rate / BASELINE_ROWS_PER_SEC, 3),
+                "seconds": round(best, 4),
+                "sf": sf,
+            })
+        for line in lines:
+            print(json.dumps(line))
+        _publish(lines)
     finally:
         shutil.rmtree(data_dir, ignore_errors=True)
+
+
+def _publish(lines) -> None:
+    """Record measurements in BASELINE.json's `published` map."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BASELINE.json")
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+        doc.setdefault("published", {})
+        for line in lines:
+            doc["published"][line["metric"]] = {
+                "rows_per_sec": line["value"],
+                "vs_baseline": line["vs_baseline"],
+                "sf": line["sf"],
+            }
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=2)
+        os.replace(tmp, path)
+    except Exception:
+        pass  # publishing is best-effort; the JSON lines are the contract
 
 
 if __name__ == "__main__":

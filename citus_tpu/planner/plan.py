@@ -305,13 +305,63 @@ class DistributedPlanner:
                 local.append(c)
         node = ScanNode(rel=rel, filter=ir.make_and(local), columns=cols)
         node.dist = self._table_dist(rel)
-        node.est_rows = max(1, self.stats.table_rows(rel.table))
+        base_rows = max(1, self.stats.table_rows(rel.table))
+        node.est_rows = max(1, int(base_rows
+                                   * self._selectivity(rel, local)))
         node.out_columns = {}
         for cid in cols:
             col = rel.schema.column(cid.split(".", 1)[1])
             node.out_columns[cid] = col.dtype
         node.pruned_shards = self._prune_shards(rel, local)
         return node
+
+    def _selectivity(self, rel: BoundRel, filters: list[ir.BExpr]) -> float:
+        """Product of per-conjunct selectivities from column extents
+        (uniform-distribution assumption — the pg_statistic-lite
+        estimator; defaults mirror PostgreSQL's 1/3 inequality and
+        1/ndv equality guesses)."""
+        sel = 1.0
+        for f in filters:
+            sel *= self._conjunct_selectivity(rel, f)
+        return min(1.0, max(sel, 1e-6))
+
+    def _conjunct_selectivity(self, rel: BoundRel, f: ir.BExpr) -> float:
+        col = const = None
+        op = None
+        if isinstance(f, ir.BCmp):
+            if isinstance(f.left, ir.BCol) and isinstance(f.right, ir.BConst):
+                col, op, const = f.left, f.op, f.right.value
+            elif isinstance(f.right, ir.BCol) and \
+                    isinstance(f.left, ir.BConst):
+                flip = {"<": ">", "<=": ">=", ">": "<", ">=": "<=", "=": "="}
+                if f.op in flip:
+                    col, op, const = f.right, flip[f.op], f.left.value
+        elif isinstance(f, ir.BInConst) and isinstance(f.operand, ir.BCol):
+            ndv = self.stats.column_ndv(f.operand.table, f.operand.column,
+                                        f.operand.dtype)
+            frac = (len(f.values) / ndv) if ndv else 0.05 * len(f.values)
+            return min(1.0, 1.0 - frac if f.negated else frac)
+        elif isinstance(f, ir.BBool) and f.op == "AND":
+            out = 1.0
+            for a in f.args:
+                out *= self._conjunct_selectivity(rel, a)
+            return out
+        if col is None or const is None or not col.table:
+            return 1.0 / 3.0 if isinstance(f, (ir.BCmp, ir.BBool)) else 1.0
+        ext = self.stats.column_extent(col.table, col.column, col.dtype)
+        if op == "=":
+            ndv = ext[1] if ext else None
+            return 1.0 / ndv if ndv else 0.005
+        if ext is None or ext[1] <= 1 or not isinstance(const, (int, float)):
+            return 1.0 / 3.0
+        lo, extent = ext
+        frac = (float(const) - lo) / extent  # fraction below const
+        frac = min(1.0, max(0.0, frac))
+        if op in ("<", "<="):
+            return max(frac, 1e-6)
+        if op in (">", ">="):
+            return max(1.0 - frac, 1e-6)
+        return 1.0 / 3.0
 
     def _prune_shards(self, rel: BoundRel,
                       filters: list[ir.BExpr]) -> Optional[list[int]]:
