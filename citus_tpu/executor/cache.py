@@ -59,6 +59,8 @@ def node_fingerprint(node: PlanNode) -> str:
         return f"P({node_fingerprint(node.input)};{exprs})"
     if isinstance(node, JoinNode):
         return (f"J({node.strategy};{node.join_type};{node.repart_key_idx};"
+                f"{node.build_side};{node.left_key_extents};"
+                f"{node.right_key_extents};{node.key_int32};"
                 f"{node_fingerprint(node.left)};"
                 f"{node_fingerprint(node.right)};"
                 f"{[repr(k) for k in node.left_keys]};"
@@ -86,7 +88,8 @@ def caps_signature(plan: QueryPlan, caps) -> tuple:
     order = plan_order(plan)
     return (tuple(sorted((order[k], v) for k, v in caps.repartition.items())),
             tuple(sorted((order[k], v) for k, v in caps.join_out.items())),
-            tuple(sorted((order[k], v) for k, v in caps.agg_out.items())))
+            tuple(sorted((order[k], v) for k, v in caps.agg_out.items())),
+            caps.dense_off)
 
 
 def feeds_signature(plan: QueryPlan, feeds) -> tuple:

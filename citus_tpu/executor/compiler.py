@@ -32,7 +32,8 @@ from jax import shard_map
 
 from ..catalog.distribution import HASH_TOKEN_COUNT, INT32_MIN
 from ..errors import ExecutionError, PlanningError
-from ..ops import expand_join, pack_by_target, segment_aggregate
+from ..ops import pack_by_target, segment_aggregate
+from ..ops.join import expand_join_outer, expand_join_pairs
 from ..ops.hashing import hash_token_jax
 from ..planner.plan import (
     AggregateNode,
@@ -137,6 +138,10 @@ class Capacities:
     # group count); segment_aggregate outputs slice down to this, shrinking
     # shuffle buffers AND device→host result transfer
     agg_out: dict[int, int] = None
+    # True after a dense_oob retry: statistics-planned dense structures
+    # (join key directories, dense aggregation grids) proved stale at
+    # runtime; recompile on the general sort/search paths
+    dense_off: bool = False
 
     def __post_init__(self):
         if self.agg_out is None:
@@ -145,7 +150,8 @@ class Capacities:
     def doubled(self) -> "Capacities":
         return Capacities({k: v * 2 for k, v in self.repartition.items()},
                           {k: v * 2 for k, v in self.join_out.items()},
-                          {k: v * 2 for k, v in self.agg_out.items()})
+                          {k: v * 2 for k, v in self.agg_out.items()},
+                          self.dense_off)
 
     def grown(self, overflow: int) -> "Capacities":
         """Retry sizing: at least double, and at least enough for the
@@ -157,7 +163,8 @@ class Capacities:
 
         return Capacities({k: g(v) for k, v in self.repartition.items()},
                           {k: g(v) for k, v in self.join_out.items()},
-                          {k: g(v) for k, v in self.agg_out.items()})
+                          {k: g(v) for k, v in self.agg_out.items()},
+                          self.dense_off)
 
 
 class PlanCompiler:
@@ -223,6 +230,7 @@ class PlanCompiler:
             set_device_float64(self.compute_dtype)
             blocks = self._unpack_feeds(flat_feeds)
             self._overflow = jnp.zeros((), dtype=jnp.int64)
+            self._dense_oob = jnp.zeros((), dtype=jnp.int64)
             out = self._exec(self.plan.root, blocks)
             if self.plan.root.dist.kind == "replicated":
                 # every device holds identical rows; emit from device 0 only
@@ -230,14 +238,20 @@ class PlanCompiler:
                     jnp.broadcast_to(
                         jax.lax.axis_index(SHARD_AXIS) == 0,
                         out.valid.shape))
+            topk = self.plan.device_topk
+            if topk is not None and out.valid.shape[0] > topk:
+                out = self._device_topk(out, topk)
             cols = {cid: jnp.broadcast_to(out.columns[cid],
                                           out.valid.shape)[None, :]
                     for cid in out_cids}
             nulls = {cid: jnp.broadcast_to(out.null_mask(cid),
                                            out.valid.shape)[None, :]
                      for cid in out_cids}
+            # overflow block per device: [capacity_overflow, dense_oob] —
+            # the host grows buffers for the first, drops stale dense
+            # structures for the second
             return (cols, nulls, out.valid[None, :],
-                    self._overflow.reshape(1))
+                    jnp.stack([self._overflow, self._dense_oob]))
 
         mapped = shard_map(body, mesh=self.mesh,
                            in_specs=tuple(in_specs), out_specs=out_specs,
@@ -317,25 +331,100 @@ class PlanCompiler:
                 nulls[cid] = jnp.broadcast_to(nmask, blk.valid.shape)
         return Block(cols, blk.valid, nulls)
 
+    # -- ORDER BY + LIMIT pushdown --------------------------------------
+    def _device_topk(self, blk: Block, k: int) -> Block:
+        """Per-device top-k by the plan's ORDER BY keys.
+
+        Shrinks the result transfer from the full padded buffer to
+        n_dev·k rows; the host's exact merge sort over those rows is
+        unchanged, so the device pass only needs the same total-order
+        DIRECTION as the host comparator: DESC negates floats and
+        bit-complements ints (~x is a monotone-decreasing bijection with
+        no overflow corner), NULL placement follows PG defaults."""
+        operands = []
+        keys = []
+        for e, desc, nulls_first in self.plan.host_order_by:
+            v, nmask = evaluate(e, _src(blk), jnp)
+            v = jnp.broadcast_to(v, blk.valid.shape)
+            nm = (jnp.zeros(blk.valid.shape, jnp.bool_) if nmask is None
+                  else jnp.broadcast_to(nmask, blk.valid.shape))
+            nulls_last = (not nulls_first if nulls_first is not None
+                          else not desc)
+            null_rank = (nm if nulls_last else ~nm).astype(jnp.int8)
+            ranks = [null_rank]
+            if jnp.issubdtype(v.dtype, jnp.floating):
+                # the host comparator (np.unique factorize) ranks NaN as
+                # the LARGEST value; -NaN is still NaN and would sort
+                # last under DESC, so NaN placement gets its own rank key
+                nanm = jnp.isnan(v)
+                ranks.append((~nanm if desc else nanm).astype(jnp.int8))
+                v = jnp.where(nanm, jnp.zeros((), v.dtype), v)
+                if desc:
+                    v = -v
+            elif desc:
+                v = ~v  # monotone-decreasing bijection, no overflow corner
+            keys.append((ranks, v))
+        # jnp.lexsort: LAST operand is the primary key.  Precedence
+        # (most→least): validity, key0 nulls, key0 nan-rank, key0 value, …
+        for ranks, v in reversed(keys):
+            operands.append(v)
+            operands.extend(reversed(ranks))
+        invalid = ~blk.valid
+        order = jnp.lexsort(tuple(operands) + (invalid,))[:k] \
+            .astype(jnp.int32)
+        cols = {cid: arr[order] for cid, arr in blk.columns.items()}
+        nulls = {cid: nm[order] for cid, nm in blk.nulls.items()}
+        return Block(cols, blk.valid[order], nulls)
+
     # -- joins ----------------------------------------------------------
-    def _eval_keys(self, blk: Block, keys) -> tuple[list, jnp.ndarray]:
+    def _eval_keys(self, blk: Block, keys,
+                   key_int32: tuple = ()) -> tuple[list, jnp.ndarray]:
         arrays = []
         valid = blk.valid
         if not keys:
             # keyless (cartesian) join: constant key matches every row pair
             return [jnp.zeros(blk.valid.shape, dtype=jnp.int64)], valid
-        for e in keys:
+        for i, e in enumerate(keys):
             v, nmask = evaluate(e, _src(blk), jnp)
             if not jnp.issubdtype(v.dtype, jnp.integer):
                 if e.dtype.value in ("float32", "float64"):
                     raise PlanningError(
                         "float join keys are not supported; cast to int")
                 v = v.astype(jnp.int64)
-            arrays.append(jnp.broadcast_to(v.astype(jnp.int64),
-                                           blk.valid.shape))
+            # int64 is software-emulated on TPU (every gather/compare
+            # splits into u32 pairs) — narrow to int32 whenever the
+            # planner proved both sides' value ranges fit.  Like the
+            # dense directory, the proof comes from statistics: a runtime
+            # value outside int32 (stale stats / overlay rows) raises
+            # dense_oob so the host recompiles wide instead of silently
+            # wrapping keys.  dense_off retries disable narrowing too.
+            narrow = (i < len(key_int32) and key_int32[i]
+                      and not self.caps.dense_off)
+            if narrow and v.dtype != jnp.int32:
+                wide = (v < jnp.int64(-(1 << 31))) | \
+                       (v > jnp.int64((1 << 31) - 1))
+                if nmask is not None:
+                    wide = wide & ~nmask
+                self._dense_oob = self._dense_oob + \
+                    (wide & blk.valid).sum().astype(jnp.int64)
+            kd = jnp.int32 if narrow else jnp.int64
+            arrays.append(jnp.broadcast_to(v.astype(kd), blk.valid.shape))
             if nmask is not None:
                 valid = valid & ~nmask  # SQL: NULL never joins
         return arrays, valid
+
+    def _dense_for(self, extents: tuple, keys: list) -> tuple | None:
+        """(base, extent) for a single-key build side, or None."""
+        from ..ops.join import dense_directory_ok
+
+        if self.caps.dense_off or len(keys) != 1:
+            return None
+        if not extents or extents[0] is None:
+            return None
+        base, extent = extents[0]
+        if not dense_directory_ok(extent, keys[0].shape[0]):
+            return None
+        return (int(base), int(extent))
 
     def _repartition(self, blk: Block, keys, shard_count: int,
                      placement: tuple[int, ...], capacity: int,
@@ -439,8 +528,9 @@ class PlanCompiler:
         else:
             raise ExecutionError(f"bad join strategy {node.strategy}")
 
-        lkeys, lmatch = self._eval_keys(lblk, node.left_keys)
-        rkeys, rmatch = self._eval_keys(rblk, node.right_keys)
+        key_int32 = getattr(node, "key_int32", ())
+        lkeys, lmatch = self._eval_keys(lblk, node.left_keys, key_int32)
+        rkeys, rmatch = self._eval_keys(rblk, node.right_keys, key_int32)
         # ON single-side gates: restrict MATCHING without dropping rows
         if node.left_match_filter is not None:
             lmatch = lmatch & predicate_mask(node.left_match_filter,
@@ -451,17 +541,30 @@ class PlanCompiler:
         out_cap = self.caps.join_out[id(node)]
 
         if node.join_type == "inner":
-            bidx, pidx, out_valid, overflow = expand_join(
-                rkeys, rmatch, lkeys, lmatch, out_cap)
+            # the planner picks the smaller side as build (sorted /
+            # directory side); pair emission is symmetric for inner joins
+            if getattr(node, "build_side", "right") == "left":
+                bkeys, bmatch, bblk = lkeys, lmatch, lblk
+                pkeys, pmatch, pblk = rkeys, rmatch, rblk
+                extents = getattr(node, "left_key_extents", ())
+            else:
+                bkeys, bmatch, bblk = rkeys, rmatch, rblk
+                pkeys, pmatch, pblk = lkeys, lmatch, lblk
+                extents = getattr(node, "right_key_extents", ())
+            dense = self._dense_for(extents, bkeys)
+            bidx, pidx, out_valid, _miss, overflow, dense_oob = \
+                expand_join_pairs(bkeys, bmatch, pkeys, pmatch, pmatch,
+                                  out_cap, probe_outer=False, dense=dense)
             self._overflow = self._overflow + overflow.astype(jnp.int64)
+            self._dense_oob = self._dense_oob + dense_oob.astype(jnp.int64)
             cols, nulls = {}, {}
-            for cid, arr in lblk.columns.items():
+            for cid, arr in pblk.columns.items():
                 cols[cid] = arr[pidx]
-            for cid, nmask in lblk.nulls.items():
+            for cid, nmask in pblk.nulls.items():
                 nulls[cid] = nmask[pidx]
-            for cid, arr in rblk.columns.items():
+            for cid, arr in bblk.columns.items():
                 cols[cid] = arr[bidx]
-            for cid, nmask in rblk.nulls.items():
+            for cid, nmask in bblk.nulls.items():
                 nulls[cid] = nmask[bidx]
             blk = Block(cols, out_valid, nulls)
         else:
@@ -483,17 +586,18 @@ class PlanCompiler:
         side combines matched flags across devices with psum and emits
         its unmatched rows on device 0 only.  Reference semantics:
         planner/multi_router_planner.c:187 outer-join handling."""
-        from ..ops.join import expand_join_outer
-
         probe_outer = node.join_type in ("left", "full")
         build_outer = node.join_type in ("right", "full")
         replicated_build = build_outer and node.strategy == "broadcast"
-        bidx, pidx, pair_valid, bmissing, unmatched_b, overflow = \
-            expand_join_outer(rkeys, rblk.valid, rmatch,
-                              lkeys, lblk.valid, lmatch, out_cap,
-                              probe_outer, build_outer,
-                              replicated_build, SHARD_AXIS)
+        dense = self._dense_for(getattr(node, "right_key_extents", ()),
+                                rkeys)
+        bidx, pidx, pair_valid, bmissing, unmatched_b, overflow, dense_oob \
+            = expand_join_outer(rkeys, rblk.valid, rmatch,
+                                lkeys, lblk.valid, lmatch, out_cap,
+                                probe_outer, build_outer,
+                                replicated_build, SHARD_AXIS, dense=dense)
         self._overflow = self._overflow + overflow.astype(jnp.int64)
+        self._dense_oob = self._dense_oob + dense_oob.astype(jnp.int64)
 
         cols, nulls = {}, {}
         for cid, arr in lblk.columns.items():
@@ -576,8 +680,8 @@ class PlanCompiler:
             blk = blk.with_filter(
                 jnp.broadcast_to(jax.lax.axis_index(SHARD_AXIS) == 0,
                                  blk.valid.shape))
-        if node.dense_keys is not None and node.combine in ("local",
-                                                           "repartition"):
+        if node.dense_keys is not None and not self.caps.dense_off and \
+                node.combine in ("local", "repartition"):
             return self._exec_dense_aggregate(node, blk)
         key_arrays, key_meta, values = self._agg_inputs(node, blk)
 
@@ -721,8 +825,9 @@ class PlanCompiler:
             nm = (jnp.broadcast_to(nmask, (n,)) if nmask is not None
                   else None)
             # a key outside the planned extent means the stats the grid
-            # was planned from went stale — surface as overflow (→ error
-            # after retries) rather than silently clipping into a group
+            # was planned from went stale — surface as dense_oob (→ the
+            # host retries on the sort path) rather than silently
+            # clipping into a group
             oob = (rebased < 0) | (rebased >= extent)
             if nm is not None:
                 oob = oob & ~nm
@@ -730,7 +835,7 @@ class PlanCompiler:
                 # runtime NULLs the planner didn't predict: force a retry
                 # path instead of mis-grouping them
                 oob = oob | nm
-            self._overflow = self._overflow + \
+            self._dense_oob = self._dense_oob + \
                 (oob & blk.valid).sum().astype(jnp.int64)
             if has_null and nm is not None:
                 idx = jnp.where(nm, jnp.int32(extent), idx)

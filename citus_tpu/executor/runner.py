@@ -95,8 +95,13 @@ class Executor:
                             compute_dtype, cache=self.feed_cache,
                             counters=self.counters)
         caps = self._initial_capacities(plan, feeds)
+        # device_topk + its ORDER BY keys are traced into the program
+        topk_sig = (plan.device_topk, tuple(
+            (repr(e), d, nf) for e, d, nf in plan.host_order_by)
+            if plan.device_topk is not None else ())
         fingerprint = (node_fingerprint(plan.root), plan.n_devices,
-                       str(compute_dtype), feeds_signature(plan, feeds))
+                       str(compute_dtype), feeds_signature(plan, feeds),
+                       topk_sig)
         retries = 0
         while True:
             key = fingerprint + (caps_signature(plan, caps),)
@@ -110,27 +115,51 @@ class Executor:
                 fn, out_meta = entry
                 feed_arrays = flatten_feed_arrays(plan, feeds)
             # two device→host transfers total: the bit-packed output block
-            # and the overflow counter (each transfer pays a full round
+            # and the overflow counters (each transfer pays a full round
             # trip on remote-attached TPUs)
             import jax
 
             packed, overflow = jax.device_get(fn(*feed_arrays))
-            total_overflow = int(np.asarray(overflow).sum())
-            if total_overflow == 0:
+            ov = np.asarray(overflow).reshape(-1, 2).sum(axis=0)
+            cap_overflow, dense_oob = int(ov[0]), int(ov[1])
+            if cap_overflow == 0 and dense_oob == 0:
                 break
             retries += 1
             if retries >= MAX_RETRIES:
                 raise CapacityOverflowError(
                     f"buffer overflow persisted after {retries} retries "
-                    f"({total_overflow} rows dropped)", total_overflow, 0)
-            caps = caps.grown(total_overflow)
+                    f"({cap_overflow + dense_oob} rows dropped)",
+                    cap_overflow + dense_oob, 0)
+            if dense_oob:
+                # statistics-planned dense structures (join directories,
+                # dense agg grids) saw out-of-range keys: stats were
+                # stale — recompile on the general paths.  Merge with the
+                # current capacities so growth from earlier overflow
+                # retries isn't thrown away (each wasted cycle would
+                # burn one of MAX_RETRIES)
+                fresh = self._initial_capacities(plan, feeds,
+                                                 dense_off=True)
+                caps = Capacities(
+                    {k: max(v, caps.repartition.get(k, 0))
+                     for k, v in fresh.repartition.items()},
+                    {k: max(v, caps.join_out.get(k, 0))
+                     for k, v in fresh.join_out.items()},
+                    {k: max(v, caps.agg_out.get(k, 0))
+                     for k, v in fresh.agg_out.items()},
+                    dense_off=True)
+            if cap_overflow:
+                caps = caps.grown(cap_overflow)
         cols, nulls, valid = unpack_outputs(packed, out_meta)
         result = self._host_combine(plan, cols, nulls, valid, raw)
         result.retries = retries
+        # result-transfer volume in row slots (n_dev·cap, or n_dev·k under
+        # device top-k pushdown) — EXPLAIN ANALYZE / stats surface this
+        result.device_rows_scanned = int(np.asarray(valid).size)
         return result
 
     # ------------------------------------------------------------------
-    def _initial_capacities(self, plan: QueryPlan, feeds) -> Capacities:
+    def _initial_capacities(self, plan: QueryPlan, feeds,
+                            dense_off: bool = False) -> Capacities:
         """Propagate static per-device capacities bottom-up."""
         repart_factor = self.settings.get("repartition_capacity_factor")
         join_factor = self.settings.get("join_output_capacity_factor")
@@ -180,7 +209,7 @@ class Executor:
                 in_cap = cap_of(node.input)
                 if node.combine == "global":
                     return 1
-                if node.dense_keys is not None and \
+                if node.dense_keys is not None and not dense_off and \
                         node.combine in ("local", "repartition"):
                     return node.dense_total  # fixed dense-grid output
                 est_g = node.est_groups
@@ -202,7 +231,7 @@ class Executor:
             raise ExecutionError(f"unknown node {type(node).__name__}")
 
         cap_of(plan.root)
-        return Capacities(repart, join_out, agg_out)
+        return Capacities(repart, join_out, agg_out, dense_off)
 
     # ------------------------------------------------------------------
     def _host_combine(self, plan: QueryPlan, cols, nulls, valid,

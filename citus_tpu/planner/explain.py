@@ -33,6 +33,8 @@ def format_plan(plan: QueryPlan, catalog: Catalog) -> list[str]:
         if plan.limit is not None:
             combine.append(f"limit {plan.limit}")
         lines.append("  " + "  ".join(combine))
+    if plan.device_topk is not None:
+        lines.append(f"  Device TopK: {plan.device_topk} rows/device")
     _format_node(plan.root, lines, 1)
     return lines
 
@@ -57,7 +59,19 @@ def _format_node(node: PlanNode, lines: list[str], depth: int) -> None:
         label = _JOIN_LABEL.get(node.strategy, node.strategy)
         conds = ", ".join(f"{l} = {r}" for l, r in
                           zip(node.left_keys, node.right_keys))
-        lines.append(f"{pad}-> {label} on ({conds})")
+        from ..ops.join import dense_directory_ok
+
+        build = node.left if node.build_side == "left" else node.right
+        ext = (node.left_key_extents if node.build_side == "left"
+               else node.right_key_extents)
+        # same predicate the executor applies (est_rows stands in for the
+        # padded build capacity)
+        dense = (bool(ext) and ext[0] is not None
+                 and len(node.left_keys) == 1
+                 and dense_directory_ok(ext[0][1], build.est_rows))
+        lines.append(f"{pad}-> {label} on ({conds})  "
+                     f"[build: {node.build_side}"
+                     f"{', dense directory' if dense else ''}]")
         if node.residual is not None:
             lines.append(f"{pad}     Residual: {node.residual}")
         _format_node(node.left, lines, depth + 1)

@@ -106,6 +106,19 @@ class JoinNode(PlanNode):
     # filtering the preserved side's rows (ON vs WHERE distinction)
     left_match_filter: Optional[ir.BExpr] = None
     right_match_filter: Optional[ir.BExpr] = None
+    # which side the executor sorts / builds a key directory over (the
+    # smaller side for inner joins; outer joins keep 'right' — the
+    # null-extension machinery is oriented build=right)
+    build_side: str = "right"
+    # per key pair: (base, extent) of each side's key value range from
+    # table statistics (manifest min/max — exact for committed data), or
+    # None when unknown.  Drives the dense-directory probe path and
+    # int32 key narrowing; stale ranges are caught at runtime (dense_oob)
+    left_key_extents: tuple = ()
+    right_key_extents: tuple = ()
+    # per key pair: both sides' ranges proven to fit int32 (TPU int64 is
+    # software-emulated — narrowing halves key gather/compare traffic)
+    key_int32: tuple = ()
 
 
 @dataclass
@@ -183,6 +196,12 @@ class QueryPlan:
     # cid → (table, column) for dictionary decode of string outputs
     decode: dict[str, tuple[str, str]]
     catalog_version: int = 0
+    # ORDER BY + LIMIT pushed onto the device: each device keeps only its
+    # top-(limit+offset) rows by the ORDER BY keys, so the result
+    # transfer is O(n_dev·k) instead of the full padded buffer (the
+    # device-side analogue of the reference's worker-side LIMIT pushdown,
+    # planner/multi_logical_optimizer.c worker limit handling)
+    device_topk: Optional[int] = None
 
 
 class DistributedPlanner:
@@ -263,11 +282,36 @@ class DistributedPlanner:
                 q, joined, decode)
             having = None
 
-        return QueryPlan(root=root, n_devices=self.n_devices,
+        plan = QueryPlan(root=root, n_devices=self.n_devices,
                          host_select=host_select, host_having=having,
                          host_order_by=host_order, limit=q.limit,
                          offset=q.offset, decode=decode,
                          catalog_version=self.catalog.version)
+        plan.device_topk = self._plan_device_topk(plan)
+        return plan
+
+    def _plan_device_topk(self, plan: QueryPlan) -> Optional[int]:
+        """LIMIT (+ ORDER BY) pushdown: per-device top-k selection.
+
+        Pushable when every ORDER BY key evaluates device-side with the
+        same ordering the host sort would apply — which excludes
+        dictionary-decoded strings (code order ≠ collation order).  The
+        host still sorts/limits the merged n_dev·k rows, so per-device
+        selection only has to return a superset of each device's
+        contribution to the global top-k."""
+        if plan.limit is None or plan.host_having is not None:
+            return None
+        k = plan.limit + (plan.offset or 0)
+        for e, _desc, _nf in plan.host_order_by:
+            for n in ir.walk(e):
+                if isinstance(n, ir.BCol):
+                    if n.cid in plan.decode:
+                        return None  # string order needs the dictionary
+                    if n.cid not in plan.root.out_columns:
+                        return None
+            if e.dtype == DataType.STRING:
+                return None
+        return k
 
     # -- column collection -------------------------------------------------
     def _collect_needed_columns(self, q: BoundQuery) -> dict[int, set[str]]:
@@ -727,7 +771,34 @@ class DistributedPlanner:
         node.est_rows = max(int(node.left.est_rows * node.est_expansion),
                             left.est_rows, right.est_rows)
         node.out_columns = {**left.out_columns, **right.out_columns}
+        self._annotate_join_keys(node)
         return node
+
+    def _annotate_join_keys(self, node: JoinNode) -> None:
+        """Key range stats → dense-directory extents, int32 narrowing,
+        and the build-side choice (smaller side sorts; inner joins only —
+        the outer-join null-extension path is oriented build=right)."""
+        node.left_key_extents = tuple(
+            self._key_extent(e) for e in node.left_keys)
+        node.right_key_extents = tuple(
+            self._key_extent(e) for e in node.right_keys)
+        int32_ok = []
+        for le, re in zip(node.left_key_extents, node.right_key_extents):
+            ok = False
+            if le is not None and re is not None:
+                lo = min(le[0], re[0])
+                hi = max(le[0] + le[1], re[0] + re[1])
+                ok = lo >= -(1 << 31) and hi <= (1 << 31) - 1
+            int32_ok.append(ok)
+        node.key_int32 = tuple(int32_ok)
+        if node.join_type == "inner" and node.left_keys:
+            node.build_side = ("left" if node.left.est_rows
+                               < node.right.est_rows else "right")
+
+    def _key_extent(self, e: ir.BExpr) -> tuple[int, int] | None:
+        if isinstance(e, ir.BCol) and e.table:
+            return self.stats.column_extent(e.table, e.column, e.dtype)
+        return None
 
     def _estimate_expansion(self, node: JoinNode) -> float:
         """Matches per probe row ≈ build_rows / ndv(build key) — the

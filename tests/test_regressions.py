@@ -132,3 +132,52 @@ def test_modulo_truncates_toward_zero(sess):
     r2 = sess.execute("select count(*) from m where (0 - v) % 2 = 1")
     # v=-7: (0-(-7))%2 = 7%2 = 1 → matches; v=7: (0-7)%2 = -1 → no
     assert int(r2.rows()[0][0]) == 1
+
+
+def test_device_topk_nan_desc_matches_host_order(sess):
+    """ORDER BY <float with NaN> DESC LIMIT k: the per-device top-k pass
+    must rank NaN like the host comparator (NaN = largest) or devices
+    drop exactly the rows the host would put first."""
+    sess.execute("create table tk (id int, a double precision, "
+                 "b double precision)")
+    sess.create_distributed_table("tk", "id", shard_count=4)
+    rows = [(i, float(i), 0.0 if i % 10 == 0 else 1.0) for i in range(1, 41)]
+    vals = ",".join(f"({i},{a},{b})" for i, a, b in rows)
+    sess.execute(f"insert into tk values {vals}")
+    with_limit = sess.execute(
+        "select id from tk order by a / b desc limit 5").rows()
+    no_limit = sess.execute(
+        "select id from tk order by a / b desc").rows()
+    assert [int(r[0]) for r in with_limit] == \
+        [int(r[0]) for r in no_limit[:5]]
+    # NaN rows (b = 0) come first under DESC, like the host sort
+    assert {int(r[0]) for r in with_limit[:4]} == {10, 20, 30, 40}
+
+
+def test_stale_join_extent_falls_back_without_wrong_results(sess):
+    """A dense join directory / int32 narrowing planned from stale key
+    ranges must surface dense_oob and retry on the general path — never
+    silently drop or wrap matches."""
+    from citus_tpu.executor.feed import walk_plan
+    from citus_tpu.planner.plan import JoinNode
+    from citus_tpu.sql.parser import parse_one
+
+    sess.execute("create table sa (k bigint, v int)")
+    sess.create_distributed_table("sa", "k", shard_count=4)
+    sess.execute("create table sb (k bigint, w int)")
+    sess.create_distributed_table("sb", "k", shard_count=4)
+    big = (1 << 33)  # outside any int32 narrowing
+    sess.execute(f"insert into sa values (1,10),(2,20),({big},30)")
+    sess.execute(f"insert into sb values (1,1),(2,2),({big},3)")
+    plan, cleanup = sess._plan_select(parse_one(
+        "select count(*), sum(v + w) from sa, sb where sa.k = sb.k"))
+    # simulate stale statistics: claim the keys fit [0, 4) and int32
+    for node in walk_plan(plan.root):
+        if isinstance(node, JoinNode):
+            node.left_key_extents = ((0, 4),)
+            node.right_key_extents = ((0, 4),)
+            node.key_int32 = (True,)
+    result = sess.executor.execute_plan(plan)
+    assert result.retries >= 1  # dense_oob retry happened
+    row = result.rows()[0]
+    assert int(row[0]) == 3 and int(row[1]) == 66
