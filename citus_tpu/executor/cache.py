@@ -61,6 +61,7 @@ def node_fingerprint(node: PlanNode) -> str:
         return (f"J({node.strategy};{node.join_type};{node.repart_key_idx};"
                 f"{node.build_side};{node.left_key_extents};"
                 f"{node.right_key_extents};{node.key_int32};"
+                f"{node.fuse_lookup};"
                 f"{node_fingerprint(node.left)};"
                 f"{node_fingerprint(node.right)};"
                 f"{[repr(k) for k in node.left_keys]};"
@@ -89,7 +90,8 @@ def caps_signature(plan: QueryPlan, caps) -> tuple:
     return (tuple(sorted((order[k], v) for k, v in caps.repartition.items())),
             tuple(sorted((order[k], v) for k, v in caps.join_out.items())),
             tuple(sorted((order[k], v) for k, v in caps.agg_out.items())),
-            caps.dense_off)
+            caps.dense_off,
+            tuple(sorted((order[k], v) for k, v in caps.scan_out.items())))
 
 
 def feeds_signature(plan: QueryPlan, feeds) -> tuple:

@@ -142,16 +142,16 @@ class Capacities:
     # (join key directories, dense aggregation grids) proved stale at
     # runtime; recompile on the general sort/search paths
     dense_off: bool = False
+    # post-filter compaction slots per selective scan: surviving rows
+    # pack into this many slots so downstream joins/aggregates size by
+    # the filtered estimate, not the full table
+    scan_out: dict[int, int] = None
 
     def __post_init__(self):
         if self.agg_out is None:
             self.agg_out = {}
-
-    def doubled(self) -> "Capacities":
-        return Capacities({k: v * 2 for k, v in self.repartition.items()},
-                          {k: v * 2 for k, v in self.join_out.items()},
-                          {k: v * 2 for k, v in self.agg_out.items()},
-                          self.dense_off)
+        if self.scan_out is None:
+            self.scan_out = {}
 
     def grown(self, overflow: int) -> "Capacities":
         """Retry sizing: at least double, and at least enough for the
@@ -164,7 +164,8 @@ class Capacities:
         return Capacities({k: g(v) for k, v in self.repartition.items()},
                           {k: g(v) for k, v in self.join_out.items()},
                           {k: g(v) for k, v in self.agg_out.items()},
-                          self.dense_off)
+                          self.dense_off,
+                          {k: g(v) for k, v in self.scan_out.items()})
 
 
 class PlanCompiler:
@@ -311,6 +312,9 @@ class PlanCompiler:
                 mask = predicate_mask(node.filter,
                                       _src(blk), jnp)
                 blk = blk.with_filter(mask)
+                k = self.caps.scan_out.get(id(node))
+                if k is not None and k < blk.valid.shape[0]:
+                    blk = self._compact(blk, k)
             return blk
         if isinstance(node, ProjectNode):
             blk = self._exec(node.input, feeds)
@@ -320,6 +324,29 @@ class PlanCompiler:
         if isinstance(node, AggregateNode):
             return self._exec_aggregate(node, feeds)
         raise ExecutionError(f"unknown plan node {type(node).__name__}")
+
+    def _compact(self, blk: Block, k: int) -> Block:
+        """Pack surviving rows into k slots (selection-vector compaction).
+
+        A selective filter leaves the block mostly padding; every
+        downstream sort/shuffle/join still pays for the full capacity.
+        Compaction costs one cumsum + one unique-index scatter + one
+        gather per column at the OLD size, and shrinks everything after
+        it to the filtered-estimate size.  More survivors than k counts
+        as capacity overflow (host retries with doubled slots)."""
+        n = blk.valid.shape[0]
+        rank = jnp.cumsum(blk.valid.astype(jnp.int32)) - 1
+        n_valid = jnp.where(n > 0, rank[n - 1] + 1, 0)
+        # the j-th surviving row's position, via unique-index scatter-set
+        por = jnp.zeros(k, jnp.int32).at[
+            jnp.where(blk.valid & (rank < k), rank, k)].set(
+            jnp.arange(n, dtype=jnp.int32), mode="drop")
+        out_valid = jnp.arange(k, dtype=jnp.int32) < jnp.minimum(n_valid, k)
+        cols = {cid: arr[por] for cid, arr in blk.columns.items()}
+        nulls = {cid: nm[por] for cid, nm in blk.nulls.items()}
+        self._overflow = self._overflow + \
+            jnp.maximum(n_valid - k, 0).astype(jnp.int64)
+        return Block(cols, out_valid, nulls)
 
     def _project(self, blk: Block, exprs) -> Block:
         cols, nulls = {}, {}
@@ -492,7 +519,11 @@ class PlanCompiler:
                 cols[cid] = flat
         return Block(cols, new_valid.reshape(flat_n), nulls)
 
-    def _exec_join(self, node: JoinNode, feeds) -> Block:
+    def _join_inputs(self, node: JoinNode, feeds):
+        """Execute both sides + repartition stages + key evaluation.
+
+        Returns (lblk, rblk, lkeys, lmatch, rkeys, rmatch) — shared by
+        pair-emission execution and the aggregate-pushdown path."""
         lblk = self._exec(node.left, feeds)
         rblk = self._exec(node.right, feeds)
 
@@ -538,6 +569,75 @@ class PlanCompiler:
         if node.right_match_filter is not None:
             rmatch = rmatch & predicate_mask(node.right_match_filter,
                                              _src(rblk), jnp)
+        return lblk, rblk, lkeys, lmatch, rkeys, rmatch
+
+    def _exec_lookup_join(self, node: JoinNode, lblk, rblk, lkeys, lmatch,
+                          rkeys, rmatch) -> Block:
+        """Fused PK-side lookup join: one output row per probe row.
+
+        No pair-expansion buffers, no emission scan — probe columns pass
+        through untouched and build columns arrive by one gather.  A
+        probe with >1 match means the planner's uniqueness claim was
+        stale: the surplus is reported as dense_oob so the host retries
+        on the general expansion path (never silently dropped pairs)."""
+        from ..ops.join import _bounds
+
+        if node.join_type == "inner" and \
+                getattr(node, "build_side", "right") == "left":
+            bblk, bkeys, bmatch = lblk, lkeys, lmatch
+            pblk, pkeys, pmatch = rblk, rkeys, rmatch
+            extents = getattr(node, "left_key_extents", ())
+        else:  # inner build=right, or LEFT join (build is always right)
+            bblk, bkeys, bmatch = rblk, rkeys, rmatch
+            pblk, pkeys, pmatch = lblk, lkeys, lmatch
+            extents = getattr(node, "right_key_extents", ())
+        dense = self._dense_for(extents, bkeys)
+        order, lo, hi, dense_oob = _bounds(bkeys, bmatch, pkeys, dense)
+        counts = jnp.where(pmatch, hi - lo, 0)
+        self._dense_oob = self._dense_oob + dense_oob.astype(jnp.int64) + \
+            jnp.maximum(counts - 1, 0).sum().astype(jnp.int64)
+        found = counts > 0
+        m = bkeys[0].shape[0]
+        bidx = order[jnp.clip(lo, 0, m - 1)]
+        probe_outer = node.join_type == "left"
+        out_valid = pblk.valid if probe_outer else found
+        # selective FK join: compact the probe side BEFORE gathering
+        # build columns, so the gathers and everything downstream run at
+        # the join-estimate size instead of the probe capacity
+        k = self.caps.join_out.get(id(node))
+        if (not probe_outer and node.residual is None and k is not None
+                and k < out_valid.shape[0]):
+            marker = "__bidx__"
+            tmp = Block({**pblk.columns, marker: bidx}, out_valid,
+                        pblk.nulls)
+            tmp = self._compact(tmp, k)
+            bidx = tmp.columns.pop(marker)
+            pblk = Block(tmp.columns, tmp.valid, tmp.nulls)
+            out_valid = tmp.valid
+        cols = dict(pblk.columns)
+        nulls = dict(pblk.nulls)
+        for cid, arr in bblk.columns.items():
+            cols[cid] = arr[bidx]
+            nm = bblk.nulls.get(cid)
+            gathered = nm[bidx] if nm is not None else None
+            if probe_outer:
+                missing = ~found
+                nulls[cid] = (missing if gathered is None
+                              else (gathered | missing))
+            elif gathered is not None:
+                nulls[cid] = gathered
+        return Block(cols, out_valid, nulls)
+
+    def _exec_join(self, node: JoinNode, feeds) -> Block:
+        lblk, rblk, lkeys, lmatch, rkeys, rmatch = \
+            self._join_inputs(node, feeds)
+        if getattr(node, "fuse_lookup", False) and not self.caps.dense_off:
+            blk = self._exec_lookup_join(node, lblk, rblk, lkeys, lmatch,
+                                         rkeys, rmatch)
+            if node.residual is not None:
+                blk = blk.with_filter(predicate_mask(node.residual,
+                                                     _src(blk), jnp))
+            return blk
         out_cap = self.caps.join_out[id(node)]
 
         if node.join_type == "inner":
@@ -673,7 +773,93 @@ class PlanCompiler:
         values = self._agg_values(node, blk)
         return key_arrays, key_meta, values
 
+    def _try_join_agg_pushdown(self, node: AggregateNode, feeds):
+        """Global aggregate over an inner join WITHOUT pair emission.
+
+        count(*) over a join is sum(matches-per-probe-row); sum/min/max
+        whose arguments come from one side reduce over that side weighted
+        by match counts.  The O(pairs) emission buffer (and its overflow
+        retries) disappear entirely — the analogue of the reference
+        pushing count/sum into worker queries instead of shipping join
+        rows (planner/multi_logical_optimizer.c WorkerExtendedOpNode).
+        Returns None when the shape doesn't qualify."""
+        from ..planner import expr as ir
+        from ..ops.join import _bounds
+
+        if node.combine != "global" or node.group_keys:
+            return None
+        j = node.input
+        if not isinstance(j, JoinNode) or j.join_type != "inner" or \
+                j.residual is not None:
+            return None
+        if j.dist.kind == "replicated":
+            return None  # both sides replicated: psum would double-count
+        lcids = set(j.left.out_columns)
+        rcids = set(j.right.out_columns)
+        agg_side = None
+        for a, _cid in node.aggs:
+            if a.kind == "count_star":
+                continue
+            if a.kind not in ("count", "sum", "min", "max"):
+                return None
+            cids = {c.cid for c in ir.walk(a.arg) if isinstance(c, ir.BCol)}
+            side = ("left" if cids <= lcids
+                    else "right" if cids <= rcids else None)
+            if side is None or (agg_side is not None and side != agg_side):
+                return None
+            agg_side = side
+        if agg_side is None:
+            # count(*) only: probe whichever side the planner made probe
+            agg_side = ("left" if getattr(j, "build_side", "right")
+                        == "right" else "right")
+
+        lblk, rblk, lkeys, lmatch, rkeys, rmatch = \
+            self._join_inputs(j, feeds)
+        if agg_side == "left":
+            pblk, pkeys, pmatch = lblk, lkeys, lmatch
+            bkeys, bmatch = rkeys, rmatch
+            extents = getattr(j, "right_key_extents", ())
+        else:
+            pblk, pkeys, pmatch = rblk, rkeys, rmatch
+            bkeys, bmatch = lkeys, lmatch
+            extents = getattr(j, "left_key_extents", ())
+        dense = self._dense_for(extents, bkeys)
+        _order, lo, hi, dense_oob = _bounds(bkeys, bmatch, pkeys, dense)
+        self._dense_oob = self._dense_oob + dense_oob.astype(jnp.int64)
+        counts = jnp.where(pmatch, (hi - lo).astype(jnp.int64), 0)
+
+        values = self._agg_values(node, pblk)
+        cols, nulls = {}, {}
+        for (a, cid), (v, kind, vv) in zip(node.aggs, values):
+            contrib = pblk.valid if vv is None else (pblk.valid & vv)
+            w = jnp.where(contrib, counts, 0)
+            if kind == "count":
+                total = jax.lax.psum(w.sum(), SHARD_AXIS)
+                cols[cid] = total[None].astype(jnp.int64)
+                continue
+            if kind == "sum":
+                local = (jnp.where(contrib, v, jnp.zeros((), v.dtype))
+                         * w.astype(v.dtype)).sum()
+                total = jax.lax.psum(local, SHARD_AXIS)
+            elif kind == "min":
+                local = jnp.where(contrib & (w > 0), v, _big(v.dtype)).min()
+                total = jax.lax.pmin(local, SHARD_AXIS)
+            elif kind == "max":
+                local = jnp.where(contrib & (w > 0), v,
+                                  _small(v.dtype)).max()
+                total = jax.lax.pmax(local, SHARD_AXIS)
+            else:
+                raise ExecutionError(f"bad agg kind {kind}")
+            cols[cid] = total[None].astype(v.dtype)
+            any_pairs = jax.lax.psum(w.sum(), SHARD_AXIS) > 0
+            nulls[cid] = (~any_pairs)[None]
+        my_dev = jax.lax.axis_index(SHARD_AXIS)
+        return Block(cols, jnp.asarray([my_dev == 0]), nulls)
+
     def _exec_aggregate(self, node: AggregateNode, feeds) -> Block:
+        pushed = self._try_join_agg_pushdown(node, feeds)
+        if pushed is not None:
+            return pushed
         blk = self._exec(node.input, feeds)
         if node.input.dist.kind == "replicated":
             # replicated rows exist on every device; aggregate them once

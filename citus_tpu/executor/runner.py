@@ -87,6 +87,12 @@ class Executor:
             settings.get("max_cached_plans"))
         self.feed_cache = FeedCache(
             settings.get("max_cached_feed_bytes"))
+        # fingerprint → plan-walk-order-keyed capacities that last
+        # succeeded: a query whose first run needed overflow/dense
+        # retries starts warm runs from the converged sizes instead of
+        # re-paying the retry executions.  Keyed by walk INDEX, not node
+        # id — every execution builds a fresh QueryPlan instance
+        self._caps_memo: dict = {}
 
     # ------------------------------------------------------------------
     def execute_plan(self, plan: QueryPlan, raw: bool = False) -> ResultSet:
@@ -102,6 +108,9 @@ class Executor:
         fingerprint = (node_fingerprint(plan.root), plan.n_devices,
                        str(compute_dtype), feeds_signature(plan, feeds),
                        topk_sig)
+        memo = self._caps_memo.get(fingerprint)
+        if memo is not None:
+            caps = self._caps_from_order(plan, memo)
         retries = 0
         while True:
             key = fingerprint + (caps_signature(plan, caps),)
@@ -123,6 +132,11 @@ class Executor:
             ov = np.asarray(overflow).reshape(-1, 2).sum(axis=0)
             cap_overflow, dense_oob = int(ov[0]), int(ov[1])
             if cap_overflow == 0 and dense_oob == 0:
+                if retries:
+                    if len(self._caps_memo) > 512:
+                        self._caps_memo.clear()
+                    self._caps_memo[fingerprint] = \
+                        self._caps_to_order(plan, caps)
                 break
             retries += 1
             if retries >= MAX_RETRIES:
@@ -146,7 +160,9 @@ class Executor:
                      for k, v in fresh.join_out.items()},
                     {k: max(v, caps.agg_out.get(k, 0))
                      for k, v in fresh.agg_out.items()},
-                    dense_off=True)
+                    dense_off=True,
+                    scan_out={k: max(v, caps.scan_out.get(k, 0))
+                              for k, v in fresh.scan_out.items()})
             if cap_overflow:
                 caps = caps.grown(cap_overflow)
         cols, nulls, valid = unpack_outputs(packed, out_meta)
@@ -158,6 +174,30 @@ class Executor:
         return result
 
     # ------------------------------------------------------------------
+    @staticmethod
+    def _caps_to_order(plan: QueryPlan, caps: Capacities) -> tuple:
+        """id(node)-keyed Capacities → plan-walk-index-keyed tuple
+        (node ids are per-plan-instance; walk order is structural)."""
+        from .cache import plan_order
+
+        order = plan_order(plan)
+        return ({order[k]: v for k, v in caps.repartition.items()},
+                {order[k]: v for k, v in caps.join_out.items()},
+                {order[k]: v for k, v in caps.agg_out.items()},
+                caps.dense_off,
+                {order[k]: v for k, v in caps.scan_out.items()})
+
+    @staticmethod
+    def _caps_from_order(plan: QueryPlan, memo: tuple) -> Capacities:
+        from .cache import plan_order
+
+        rev = {i: nid for nid, i in plan_order(plan).items()}
+        return Capacities({rev[i]: v for i, v in memo[0].items()},
+                          {rev[i]: v for i, v in memo[1].items()},
+                          {rev[i]: v for i, v in memo[2].items()},
+                          memo[3],
+                          {rev[i]: v for i, v in memo[4].items()})
+
     def _initial_capacities(self, plan: QueryPlan, feeds,
                             dense_off: bool = False) -> Capacities:
         """Propagate static per-device capacities bottom-up."""
@@ -168,10 +208,26 @@ class Executor:
         repart: dict[int, int] = {}
         join_out: dict[int, int] = {}
         agg_out: dict[int, int] = {}
+        scan_out: dict[int, int] = {}
 
         def cap_of(node) -> int:
             if isinstance(node, ScanNode):
-                return feeds[id(node)].capacity
+                base = feeds[id(node)].capacity
+                if node.filter is None:
+                    return base
+                # selective scans compact survivors so downstream buffers
+                # size by the filtered estimate, not the table (1.5×
+                # slack over the uniform-assumption estimate; an
+                # under-estimate overflows and retries doubled, and the
+                # converged sizes are memoized per plan fingerprint)
+                est = max(1, node.est_rows)
+                per_dev = (est if not feeds[id(node)].sharded
+                           else -(-est // n_dev))
+                k = _round_cap(int(per_dev * 1.5) + 512)
+                if k < base * 0.8:
+                    scan_out[id(node)] = k
+                    return k
+                return base
             if isinstance(node, ProjectNode):
                 return cap_of(node.input)
             if isinstance(node, JoinNode):
@@ -188,6 +244,21 @@ class Executor:
                         int(max(lcap, rcap) * repart_factor))
                     lcap = n_dev * repart[id(node)]
                     rcap = n_dev * repart[id(node)]
+                if getattr(node, "fuse_lookup", False) and not dense_off \
+                        and node.left_keys:
+                    # fused PK lookup: one output slot per probe row; a
+                    # selective build side (FK match fraction < 1)
+                    # additionally compacts the output so downstream
+                    # aggregates/joins size by the join estimate
+                    out = (rcap if node.join_type == "inner"
+                           and node.build_side == "left" else lcap)
+                    if node.join_type == "inner" and node.residual is None:
+                        est = max(1, node.est_rows)
+                        k = _round_cap(int(-(-est // n_dev) * 1.5) + 512)
+                        if k < out * 0.8:
+                            out = k
+                    join_out[id(node)] = out
+                    return out
                 if not node.left_keys:
                     # cartesian: output is the full product
                     out = _round_cap(lcap * rcap)
@@ -231,7 +302,7 @@ class Executor:
             raise ExecutionError(f"unknown node {type(node).__name__}")
 
         cap_of(plan.root)
-        return Capacities(repart, join_out, agg_out, dense_off)
+        return Capacities(repart, join_out, agg_out, dense_off, scan_out)
 
     # ------------------------------------------------------------------
     def _host_combine(self, plan: QueryPlan, cols, nulls, valid,

@@ -6,6 +6,12 @@ executor/partitioned_intermediate_results.c:108) — rebuilt as a dense pack
 whose output feeds `jax.lax.all_to_all` over ICI directly, replacing the
 fetch_intermediate_results COPY-over-TCP hop entirely (SURVEY §3.2).
 
+The pack is formulated as a GATHER, not a scatter: rows sort by target
+(one cheap int32 argsort), each target's rows then occupy a contiguous
+run of sorted positions, and output slot (t, r) pulls sorted position
+starts[t] + r.  Per-column work is a single gather — TPU scatters
+serialize on combining, gathers don't.
+
 Static capacity per target partition; the overflow count is returned so the
 host can re-run with a larger capacity (count-then-emit at host granularity).
 """
@@ -41,24 +47,31 @@ def partition_ranks(target: jnp.ndarray, valid: jnp.ndarray, n_targets: int,
 def pack_by_target(columns: dict[str, jnp.ndarray], valid: jnp.ndarray,
                    target: jnp.ndarray, n_targets: int, capacity: int,
                    ) -> tuple[dict[str, jnp.ndarray], jnp.ndarray, jnp.ndarray]:
-    """Scatter rows into [n_targets, capacity] per column.
+    """Arrange rows into [n_targets, capacity] per column.
 
     Returns (packed_columns, packed_valid [n_targets, capacity],
     overflow_count — rows dropped because their partition exceeded capacity).
     Overflow > 0 ⇒ results incomplete ⇒ host retries with larger capacity.
     """
-    rank, counts = partition_ranks(target, valid, n_targets)
-    in_cap = rank < capacity
-    ok = valid & in_cap
-    flat_idx = jnp.where(ok, target * capacity + rank,
-                         n_targets * capacity)  # OOB → dropped
-    packed_valid = jnp.zeros(n_targets * capacity, dtype=jnp.bool_
-                             ).at[flat_idx].set(ok, mode="drop")
+    n = target.shape[0]
+    t = jnp.where(valid, target, n_targets).astype(jnp.int32)
+    order = jnp.argsort(t, stable=True).astype(jnp.int32)
+    counts = jax.ops.segment_sum(valid.astype(jnp.int32), t,
+                                 num_segments=n_targets + 1)[:n_targets]
+    starts = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                              jnp.cumsum(counts, dtype=jnp.int32)])[:-1]
+
+    # slot (t, r) ← sorted position starts[t] + r (gather, no scatter)
+    slots = jnp.arange(n_targets * capacity, dtype=jnp.int32)
+    ti = slots // capacity
+    r = slots - ti * capacity
+    packed_valid = r < counts[ti]
+    sp = jnp.clip(starts[ti] + r, 0, max(n - 1, 0))
+    src_row = order[sp]
     packed = {}
     for name, col in columns.items():
-        buf = jnp.zeros(n_targets * capacity, dtype=col.dtype)
-        buf = buf.at[flat_idx].set(jnp.where(ok, col, jnp.zeros((), col.dtype)),
-                                   mode="drop")
+        buf = jnp.where(packed_valid, col[src_row],
+                        jnp.zeros((), col.dtype))
         packed[name] = buf.reshape(n_targets, capacity)
     overflow = jnp.maximum(counts - capacity, 0).sum()
     return packed, packed_valid.reshape(n_targets, capacity), overflow

@@ -71,7 +71,8 @@ def _format_node(node: PlanNode, lines: list[str], depth: int) -> None:
                  and dense_directory_ok(ext[0][1], build.est_rows))
         lines.append(f"{pad}-> {label} on ({conds})  "
                      f"[build: {node.build_side}"
-                     f"{', dense directory' if dense else ''}]")
+                     f"{', dense directory' if dense else ''}"
+                     f"{', fused lookup' if node.fuse_lookup else ''}]")
         if node.residual is not None:
             lines.append(f"{pad}     Residual: {node.residual}")
         _format_node(node.left, lines, depth + 1)

@@ -119,6 +119,12 @@ class JoinNode(PlanNode):
     # per key pair: both sides' ranges proven to fit int32 (TPU int64 is
     # software-emulated — narrowing halves key gather/compare traffic)
     key_int32: tuple = ()
+    # statistics say the build side is unique on the join key (PK side):
+    # the executor fuses the join as a per-probe lookup — output block ==
+    # probe block + gathered build columns, no pair-expansion buffers.
+    # A runtime duplicate (stale stats) surfaces as dense_oob and retries
+    # on the general expansion path
+    fuse_lookup: bool = False
 
 
 @dataclass
@@ -791,9 +797,47 @@ class DistributedPlanner:
                 ok = lo >= -(1 << 31) and hi <= (1 << 31) - 1
             int32_ok.append(ok)
         node.key_int32 = tuple(int32_ok)
+        exp_left = self._estimate_expansion_for(node.left, node.left_keys)
+        exp_right = self._estimate_expansion_for(node.right,
+                                                 node.right_keys)
+        uniq_l = exp_left is not None and exp_left <= 1.0
+        uniq_r = exp_right is not None and exp_right <= 1.0
         if node.join_type == "inner" and node.left_keys:
-            node.build_side = ("left" if node.left.est_rows
-                               < node.right.est_rows else "right")
+            # prefer a provably-unique side as build (enables lookup
+            # fusion); otherwise sort the smaller side
+            if uniq_l != uniq_r:
+                node.build_side = "left" if uniq_l else "right"
+            else:
+                node.build_side = ("left" if node.left.est_rows
+                                   < node.right.est_rows else "right")
+        if node.left_keys:
+            build_uniq = (uniq_l if node.build_side == "left" else uniq_r)
+            node.fuse_lookup = (build_uniq and node.join_type
+                                in ("inner", "left"))
+        if node.fuse_lookup and node.join_type == "inner":
+            # PK-side build: P(probe row matches) ≈ surviving build
+            # fraction — the FK-join selectivity the generic estimate
+            # (max of side estimates) misses entirely.  Feeds join-output
+            # compaction, aggregate sizing, and group-count estimates.
+            build = node.left if node.build_side == "left" else node.right
+            probe = node.right if node.build_side == "left" else node.left
+            base = self._unfiltered_rows(build)
+            frac = min(1.0, build.est_rows / base) if base > 0 else 1.0
+            node.est_rows = max(1, int(probe.est_rows * frac))
+
+    def _unfiltered_rows(self, node: PlanNode) -> int:
+        """Rows the node would produce with every filter removed — the
+        denominator for FK-match-fraction estimation."""
+        if isinstance(node, ScanNode):
+            return max(1, self.stats.table_rows(node.rel.table))
+        if isinstance(node, ProjectNode):
+            return self._unfiltered_rows(node.input)
+        if isinstance(node, JoinNode) and node.fuse_lookup and \
+                node.join_type == "inner":
+            probe = (node.right if node.build_side == "left"
+                     else node.left)
+            return self._unfiltered_rows(probe)
+        return max(1, node.est_rows)
 
     def _key_extent(self, e: ir.BExpr) -> tuple[int, int] | None:
         if isinstance(e, ir.BCol) and e.table:
@@ -804,17 +848,26 @@ class DistributedPlanner:
         """Matches per probe row ≈ build_rows / ndv(build key) — the
         pg_statistic-style selectivity estimate for equi-joins; min over
         edges (every key must match), 1.0 when unknown/PK-like."""
+        best = self._estimate_expansion_for(node.right, node.right_keys)
+        return max(1.0, best) if best is not None else 1.0
+
+    def _estimate_expansion_for(self, build_node: PlanNode,
+                                build_keys) -> float | None:
+        """Raw matches-per-probe estimate for one side as build; None =
+        no usable statistics.  A value <= 1.0 marks the side as
+        PK-unique on the key (lookup-fusion eligible — verified at
+        runtime, stale claims retry on the expansion path)."""
         best = None
-        build_rows = max(1, node.right.est_rows)
-        for rk in node.right_keys:
-            if not (isinstance(rk, ir.BCol) and rk.table):
+        rows = max(1, build_node.est_rows)
+        for k in build_keys:
+            if not (isinstance(k, ir.BCol) and k.table):
                 continue
-            ndv = self.stats.column_ndv(rk.table, rk.column, rk.dtype)
+            ndv = self.stats.column_ndv(k.table, k.column, k.dtype)
             if ndv is None or ndv <= 0:
                 continue
-            e = build_rows / ndv
+            e = rows / ndv
             best = e if best is None else min(best, e)
-        return max(1.0, best) if best is not None else 1.0
+        return best
 
     # -- aggregation -------------------------------------------------------
     def _plan_aggregate(self, q: BoundQuery, input_node: PlanNode,

@@ -181,3 +181,18 @@ def test_stale_join_extent_falls_back_without_wrong_results(sess):
     assert result.retries >= 1  # dense_oob retry happened
     row = result.rows()[0]
     assert int(row[0]) == 3 and int(row[1]) == 66
+
+    # warm re-execution of a FRESH plan instance (new node ids): the
+    # converged capacities memo must translate across plan instances and
+    # skip the retry entirely
+    plan2, _ = sess._plan_select(parse_one(
+        "select count(*), sum(v + w) from sa, sb where sa.k = sb.k"))
+    for node in walk_plan(plan2.root):
+        if isinstance(node, JoinNode):
+            node.left_key_extents = ((0, 4),)
+            node.right_key_extents = ((0, 4),)
+            node.key_int32 = (True,)
+    result2 = sess.executor.execute_plan(plan2)
+    assert result2.retries == 0
+    row2 = result2.rows()[0]
+    assert int(row2[0]) == 3 and int(row2[1]) == 66
