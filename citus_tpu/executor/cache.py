@@ -71,7 +71,8 @@ def node_fingerprint(node: PlanNode) -> str:
     if isinstance(node, AggregateNode):
         groups = [(repr(g), cid) for g, cid in node.group_keys]
         aggs = [(repr(a), cid) for a, cid in node.aggs]
-        return (f"A({node.combine};{node_fingerprint(node.input)};"
+        return (f"A({node.combine};{node.repart_keys};"
+                f"{node_fingerprint(node.input)};"
                 f"{groups};{aggs};{node.dense_keys};{node.dense_total};"
                 f"{_dist_sig(node.dist)})")
     raise TypeError(f"unknown plan node {type(node).__name__}")

@@ -939,8 +939,15 @@ class PlanCompiler:
         # shuffle partial groups by key hash, then merge partials.  Key
         # arrays include the null flags so NULL groups survive the shuffle
         # (routed by flag+zero value, consistently on every device).
+        # repart_keys (DISTINCT rewrite) restricts ROUTING to a key
+        # subset — co-routed rows still merge by the full key set
+        route_idx = (set(node.repart_keys)
+                     if getattr(node, "repart_keys", None) is not None
+                     else None)
         shuffle_keys = []
-        for cid, has_null in key_meta:
+        for ki, (cid, has_null) in enumerate(key_meta):
+            if route_idx is not None and ki not in route_idx:
+                continue
             v = partial.columns[cid]
             if jnp.issubdtype(v.dtype, jnp.floating):
                 v = jax.lax.bitcast_convert_type(

@@ -100,7 +100,6 @@ class Executor:
         feeds = build_feeds(plan, self.catalog, self.store, self.mesh,
                             compute_dtype, cache=self.feed_cache,
                             counters=self.counters)
-        caps = self._initial_capacities(plan, feeds)
         # device_topk + its ORDER BY keys are traced into the program
         topk_sig = (plan.device_topk, tuple(
             (repr(e), d, nf) for e, d, nf in plan.host_order_by)
@@ -109,8 +108,8 @@ class Executor:
                        str(compute_dtype), feeds_signature(plan, feeds),
                        topk_sig)
         memo = self._caps_memo.get(fingerprint)
-        if memo is not None:
-            caps = self._caps_from_order(plan, memo)
+        caps = (self._caps_from_order(plan, memo) if memo is not None
+                else self._initial_capacities(plan, feeds))
         retries = 0
         while True:
             key = fingerprint + (caps_signature(plan, caps),)

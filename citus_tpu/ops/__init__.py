@@ -14,12 +14,12 @@ from .join import (
     match_counts,
     sort_build_side,
 )
-from .partition import pack_by_target, partition_ranks
+from .partition import pack_by_target
 
 __all__ = [
     "distinct", "segment_aggregate", "combine_hash64", "fmix32_jax",
     "hash_token_jax", "shard_index_for_values_jax", "shard_index_from_token",
     "expand_join", "expand_join_pairs", "lookup_join", "lower_bound",
     "match_counts",
-    "sort_build_side", "pack_by_target", "partition_ranks",
+    "sort_build_side", "pack_by_target",
 ]

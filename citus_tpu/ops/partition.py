@@ -22,28 +22,6 @@ import jax
 import jax.numpy as jnp
 
 
-def partition_ranks(target: jnp.ndarray, valid: jnp.ndarray, n_targets: int,
-                    ) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """Per-row rank within its target partition + per-target counts.
-
-    target: [N] int32 in [0, n_targets); rank via stable sort by target.
-    Returns (rank [N] — only meaningful for valid rows, counts [n_targets]).
-    """
-    n = target.shape[0]
-    t = jnp.where(valid, target, n_targets).astype(jnp.int32)
-    order = jnp.argsort(t, stable=True)
-    t_sorted = t[order]
-    # first occurrence index of each target value among sorted rows
-    first = jax.ops.segment_min(jnp.arange(n, dtype=jnp.int32), t_sorted,
-                                num_segments=n_targets + 1)
-    rank_sorted = jnp.arange(n, dtype=jnp.int32) - first[t_sorted]
-    # scatter ranks back to original row order
-    rank = jnp.zeros(n, dtype=jnp.int32).at[order].set(rank_sorted)
-    counts = jax.ops.segment_sum(valid.astype(jnp.int32), t,
-                                 num_segments=n_targets + 1)[:n_targets]
-    return rank, counts
-
-
 def pack_by_target(columns: dict[str, jnp.ndarray], valid: jnp.ndarray,
                    target: jnp.ndarray, n_targets: int, capacity: int,
                    ) -> tuple[dict[str, jnp.ndarray], jnp.ndarray, jnp.ndarray]:

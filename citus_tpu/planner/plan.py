@@ -145,6 +145,11 @@ class AggregateNode(PlanNode):
     # high-cardinality keys).  Entries: (base, extent, has_null) per key.
     dense_keys: Optional[tuple[tuple[int, int, bool], ...]] = None
     dense_total: int = 0
+    # combine='repartition' only: route the shuffle by THIS subset of
+    # group-key indices (None = all keys).  The DISTINCT rewrite routes
+    # the dedupe level by the outer GROUP BY keys alone so the
+    # re-aggregation level stays device-local
+    repart_keys: Optional[tuple[int, ...]] = None
 
 
 @dataclass
@@ -894,13 +899,13 @@ class DistributedPlanner:
         def register_agg(a: ir.BAgg) -> ir.BExpr:
             if a in agg_map:
                 return agg_map[a]
-            if a.distinct:
-                raise PlanningError(
-                    "aggregate DISTINCT is not supported yet")
+            if a.distinct and a.kind in ("min", "max"):
+                # DISTINCT is a no-op for min/max
+                return register_agg(ir.BAgg(a.kind, a.arg, False, a.dtype))
             if a.kind == "avg":
-                s = register_agg(ir.BAgg("sum", a.arg, False,
+                s = register_agg(ir.BAgg("sum", a.arg, a.distinct,
                                          DataType.FLOAT64))
-                c = register_agg(ir.BAgg("count", a.arg, False,
+                c = register_agg(ir.BAgg("count", a.arg, a.distinct,
                                          DataType.INT64))
                 out = ir.BArith("/", s, ir.BCast(c, DataType.FLOAT64),
                                 DataType.FLOAT64)
@@ -936,11 +941,91 @@ class DistributedPlanner:
                         "aggregate function")
             host_order.append((re_, desc, nf))
 
+        if not any(a.distinct for a, _ in aggs):
+            node = self._finish_aggregate(input_node, group_keys, aggs,
+                                          q.nullable_rels)
+            return node, host_select, having, host_order
+
+        node = self._plan_distinct_aggregate(input_node, group_keys, aggs,
+                                             q.nullable_rels)
+        return node, host_select, having, host_order
+
+    def _plan_distinct_aggregate(self, input_node: PlanNode, group_keys,
+                                 aggs, nullable_rels) -> AggregateNode:
+        """DISTINCT aggregates as a two-level split (the worker/master
+        count(distinct) rewrite of the reference's logical optimizer,
+        planner/multi_logical_optimizer.c:286 GetAggregateType — here
+        without requiring an hll extension):
+
+          inner:  GROUP BY (G…, arg)  — global dedupe; the shuffle
+                  routes by G alone so same-G rows co-locate,
+          outer:  GROUP BY G, device-local — count/sum over the deduped
+                  arg rows, re-aggregation of the non-distinct partials.
+        """
+        dargs = {a.arg for a, _ in aggs if a.distinct}
+        if len(dargs) > 1:
+            raise PlanningError(
+                "multiple DISTINCT aggregates over different "
+                "expressions are not supported")
+        darg = next(iter(dargs))
+        inner_keys = list(group_keys) + [(darg, "gd")]
+        inner_aggs: list[tuple[ir.BAgg, str]] = []
+        outer_aggs: list[tuple[ir.BAgg, str]] = []
+        for a, cid in aggs:
+            if a.distinct:
+                outer_aggs.append((ir.BAgg(
+                    a.kind, ir.BCol("gd", darg.dtype), False, a.dtype),
+                    cid))
+            else:
+                pcid = f"p{len(inner_aggs)}"
+                inner_aggs.append((a, pcid))
+                okind = "sum" if a.kind in ("count", "count_star") \
+                    else a.kind
+                pdtype = (DataType.INT64
+                          if a.kind in ("count", "count_star") else a.dtype)
+                outer_aggs.append((ir.BAgg(
+                    okind, ir.BCol(pcid, pdtype), False, a.dtype), cid))
+
+        inner = self._finish_aggregate(input_node, inner_keys, inner_aggs,
+                                       nullable_rels)
+        g_cids = {g.cid for g, _ in group_keys if isinstance(g, ir.BCol)}
+        if inner.combine == "repartition" and group_keys:
+            inner.repart_keys = tuple(range(len(group_keys)))
+
+        outer_keys = [(ir.BCol(cid, g.dtype), cid)
+                      for g, cid in group_keys]
+        outer = AggregateNode(combine="", input=inner,
+                              group_keys=outer_keys, aggs=outer_aggs)
+        outer.est_groups = self._estimate_groups(group_keys, input_node)
+        if not group_keys:
+            outer.combine = "global"
+        elif inner.combine == "repartition" or (
+                input_node.dist.kind in ("hash", "device")
+                and (input_node.dist.cids & g_cids)):
+            # either the dedupe shuffle routed by G, or the input was
+            # already partitioned on a G column: G-groups device-disjoint
+            outer.combine = "local"
+        else:
+            outer.combine = "repartition"
+        outer.dist = (self.device_dist(frozenset())
+                      if outer.combine == "repartition" else inner.dist)
+        outer.est_rows = inner.est_rows
+        outer.out_columns = {}
+        for g, cid in group_keys:
+            outer.out_columns[cid] = g.dtype
+        for a, cid in outer_aggs:
+            outer.out_columns[cid] = a.dtype
+        return outer
+
+    def _finish_aggregate(self, input_node: PlanNode, group_keys, aggs,
+                          nullable_rels) -> AggregateNode:
+        """Combine-mode / distribution / estimate annotation shared by
+        plain, inner-dedupe, and outer-reaggregation nodes."""
         node = AggregateNode(
             combine="", input=input_node,
             group_keys=group_keys, aggs=aggs)
         node.est_groups = self._estimate_groups(group_keys, input_node)
-        self._plan_dense_grid(node, q.nullable_rels)
+        self._plan_dense_grid(node, nullable_rels)
         gk_cids = set()
         for g, _ in group_keys:
             if isinstance(g, ir.BCol):
@@ -952,15 +1037,22 @@ class DistributedPlanner:
             node.combine = "local"  # groups already device-disjoint
         else:
             node.combine = "repartition"
-        node.dist = (self.device_dist(frozenset(gk_cids))
-                     if node.combine == "repartition" else input_node.dist)
+        if node.combine != "repartition":
+            node.dist = input_node.dist
+        elif len(group_keys) == 1 and gk_cids:
+            node.dist = self.device_dist(frozenset(gk_cids))
+        else:
+            # multi-key shuffles route by the COMPOSITE hash; claiming
+            # per-column partitioning would let a stacked consumer
+            # falsely align (same rule as repart_both joins)
+            node.dist = self.device_dist(frozenset())
         node.est_rows = input_node.est_rows
         node.out_columns = {}
         for g, cid in group_keys:
             node.out_columns[cid] = g.dtype
         for a, cid in aggs:
             node.out_columns[cid] = a.dtype
-        return node, host_select, having, host_order
+        return node
 
     DENSE_GROUP_LIMIT = 8192
 
