@@ -76,6 +76,17 @@ _register(ConfigVar(
     "Static aggregate-output headroom over the estimated group count.",
     float, min_value=1.0, max_value=64.0))
 _register(ConfigVar(
+    "enable_fast_path_router", True,
+    "Execute single-shard pruned queries host-side, skipping the mesh "
+    "program entirely (ref: citus.enable_fast_path_router_planner, "
+    "planner/fast_path_router_planner.c:530).",
+    bool))
+_register(ConfigVar(
+    "fast_path_max_rows", 65536,
+    "Row ceiling for host-side fast-path execution; bigger single-shard "
+    "scans still use the device path.",
+    int, min_value=0, max_value=1 << 24))
+_register(ConfigVar(
     "max_cached_plans", 256,
     "Compiled-executable cache entries; a structurally repeated query "
     "skips XLA trace+compile (ref: planner/local_plan_cache.c:1-60).",

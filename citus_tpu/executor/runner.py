@@ -60,6 +60,7 @@ class ResultSet:
     # execution metadata (EXPLAIN ANALYZE / stats counters read these)
     retries: int = 0
     device_rows_scanned: int = 0
+    fast_path: bool = False   # executed host-side via the fast-path router
     # per-column NULL masks (raw mode keeps typed arrays + mask instead of
     # objectified None entries); None when columns carry None directly
     null_masks: dict[str, np.ndarray] | None = None
@@ -96,6 +97,11 @@ class Executor:
 
     # ------------------------------------------------------------------
     def execute_plan(self, plan: QueryPlan, raw: bool = False) -> ResultSet:
+        from .fastpath import try_execute_fast_path
+
+        fast = try_execute_fast_path(self, plan, raw)
+        if fast is not None:
+            return fast
         compute_dtype = np.dtype(self.settings.get("compute_dtype"))
         feeds = build_feeds(plan, self.catalog, self.store, self.mesh,
                             compute_dtype, cache=self.feed_cache,

@@ -20,7 +20,8 @@ _JOIN_LABEL = {
 }
 
 
-def format_plan(plan: QueryPlan, catalog: Catalog) -> list[str]:
+def format_plan(plan: QueryPlan, catalog: Catalog,
+                settings=None) -> list[str]:
     lines = [f"Distributed Query  (devices: {plan.n_devices})"]
     if plan.host_order_by or plan.limit is not None or plan.host_having:
         combine = ["Host Combine:"]
@@ -35,6 +36,13 @@ def format_plan(plan: QueryPlan, catalog: Catalog) -> list[str]:
         lines.append("  " + "  ".join(combine))
     if plan.device_topk is not None:
         lines.append(f"  Device TopK: {plan.device_topk} rows/device")
+    from ..executor.fastpath import fast_path_shape
+
+    enabled = (settings is None
+               or settings.get("enable_fast_path_router"))
+    if enabled and fast_path_shape(plan, catalog):
+        lines.append("  Fast Path Router: single-shard host execution "
+                     "(below fast_path_max_rows)")
     _format_node(plan.root, lines, 1)
     return lines
 

@@ -177,6 +177,8 @@ class Session:
                 c.increment(sc.CAPACITY_RETRIES, result.retries)
                 c.increment(sc.DEVICE_ROWS_SCANNED,
                             result.device_rows_scanned)
+                if getattr(result, "fast_path", False):
+                    c.increment(sc.QUERIES_FAST_PATH)
         elif isinstance(stmt, ast.Update):
             c.increment(sc.DML_UPDATE)
         elif isinstance(stmt, ast.Delete):
@@ -629,7 +631,7 @@ class Session:
         if self.settings.get("log_distributed_plans"):
             import sys
 
-            for line in format_plan(plan, self.catalog):
+            for line in format_plan(plan, self.catalog, self.settings):
                 print(line, file=sys.stderr)
         return plan, cleanup
 
@@ -640,7 +642,7 @@ class Session:
             raise UnsupportedQueryError("EXPLAIN supports SELECT only")
         plan, cleanup = self._plan_select(stmt.statement)
         try:
-            lines = format_plan(plan, self.catalog)
+            lines = format_plan(plan, self.catalog, self.settings)
             if stmt.analyze:
                 import time
 

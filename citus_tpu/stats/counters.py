@@ -19,6 +19,7 @@ from collections import defaultdict
 QUERIES_SINGLE_SHARD = "queries_single_shard"
 QUERIES_MULTI_SHARD = "queries_multi_shard"
 QUERIES_REPARTITION = "queries_repartition"
+QUERIES_FAST_PATH = "queries_fast_path"
 SUBPLANS_EXECUTED = "subplans_executed"
 ROWS_INGESTED = "rows_ingested"
 ROWS_RETURNED = "rows_returned"
@@ -35,6 +36,7 @@ CHUNKS_SKIPPED = "chunks_skipped"
 
 ALL_COUNTERS = [
     QUERIES_SINGLE_SHARD, QUERIES_MULTI_SHARD, QUERIES_REPARTITION,
+    QUERIES_FAST_PATH,
     SUBPLANS_EXECUTED, ROWS_INGESTED, ROWS_RETURNED,
     DML_UPDATE, DML_DELETE, DML_MERGE, DDL_COMMANDS,
     CAPACITY_RETRIES, DEVICE_ROWS_SCANNED,

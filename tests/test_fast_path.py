@@ -1,0 +1,89 @@
+"""Fast-path router: single-shard pruned queries execute host-side
+(reference: planner/fast_path_router_planner.c:530,
+distributed_planner.c:719 — VERDICT round-2 item 3)."""
+
+import time
+
+import pytest
+
+import citus_tpu
+from citus_tpu.stats import counters as sc
+
+
+@pytest.fixture()
+def sess(tmp_path):
+    s = citus_tpu.connect(data_dir=str(tmp_path / "d"), n_devices=4,
+                          compute_dtype="float64")
+    s.execute("create table kv (k bigint, v bigint, s text)")
+    s.create_distributed_table("kv", "k", shard_count=8)
+    vals = ",".join(f"({i},{i * 10},'name{i % 5}')" for i in range(1, 501))
+    s.execute(f"insert into kv values {vals}")
+    s.execute("create table ref (v bigint, label text)")
+    s.execute("select create_reference_table('ref')")
+    s.execute("insert into ref values (10,'ten'), (20,'twenty'), "
+              "(30,'thirty')")
+    yield s
+    s.close()
+
+
+def test_point_lookup_correct_and_counted(sess):
+    before = sess.stats.counters.snapshot().get(sc.QUERIES_FAST_PATH, 0)
+    r = sess.execute("select v, s from kv where k = 42")
+    assert getattr(r, "fast_path", False)
+    assert r.rows() == [(420, "name2")]
+    after = sess.stats.counters.snapshot().get(sc.QUERIES_FAST_PATH, 0)
+    assert after == before + 1
+    # device path untouched
+    assert r.device_rows_scanned == 0
+
+
+def test_fast_path_join_with_reference_table(sess):
+    r = sess.execute("select s, label from kv, ref where k = 1 "
+                     "and kv.v = ref.v")
+    assert getattr(r, "fast_path", False)
+    assert r.rows() == [("name1", "ten")]
+    r2 = sess.execute("select label from kv left join ref "
+                      "on kv.v = ref.v where k = 5")
+    assert getattr(r2, "fast_path", False)
+    assert r2.rows() == [(None,)]
+
+
+def test_fast_path_matches_device_path(sess):
+    q = "select v, s from kv where k = 7"
+    fast = sess.execute(q)
+    assert fast.fast_path
+    sess.execute("set enable_fast_path_router = false")
+    slow = sess.execute(q)
+    assert not getattr(slow, "fast_path", False)
+    sess.execute("set enable_fast_path_router = true")
+    assert fast.rows() == slow.rows()
+
+
+def test_multi_shard_and_aggregates_not_fast_pathed(sess):
+    r = sess.execute("select count(*) from kv where k = 3")
+    assert not getattr(r, "fast_path", False)  # aggregate → device path
+    assert int(r.rows()[0][0]) == 1
+    r2 = sess.execute("select v from kv where v = 10")
+    assert not getattr(r2, "fast_path", False)  # no distcol pruning
+
+
+def test_explain_shows_fast_path(sess):
+    lines = [row[0] for row in
+             sess.execute("explain select v from kv where k = 9").rows()]
+    assert any("Fast Path Router" in line for line in lines)
+    lines2 = [row[0] for row in
+              sess.execute("explain select v from kv").rows()]
+    assert not any("Fast Path Router" in line for line in lines2)
+
+
+def test_point_lookup_latency(sess):
+    sess.execute("select v from kv where k = 11")  # warm
+    times = []
+    for i in range(20):
+        t0 = time.perf_counter()
+        sess.execute(f"select v from kv where k = {11 + i}")
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    p50 = times[len(times) // 2]
+    # VERDICT target: warm point lookup p50 < 5 ms
+    assert p50 < 0.005, f"p50 {p50 * 1e3:.2f} ms"
