@@ -200,18 +200,46 @@ class Catalog:
     def remove_node(self, name: str) -> None:
         with self._lock:
             node = self.node_by_name(name)
-            used = [p for p in self.placements.values()
-                    if p.node_id == node.node_id and p.shard_state == "active"
-                    and self.shards[p.shard_id].min_value is not None]
-            if used:
-                raise CatalogError(
-                    f"cannot remove node {name!r}: it still hosts "
-                    f"{len(used)} shard placement(s); rebalance first")
-            # drop this node's remaining placements (reference-table replicas
-            # and to_delete leftovers) so no placement dangles on a dead node
+            for p in self.placements.values():
+                if p.node_id != node.node_id or p.shard_state != "active" \
+                        or self.shards[p.shard_id].min_value is None:
+                    continue
+                # removable only if every hosted shard keeps at least one
+                # replica on another live node (reference semantics: a
+                # node with sole placements must be rebalanced away first)
+                survivors = [
+                    q for q in self.placements.values()
+                    if q.shard_id == p.shard_id
+                    and q.placement_id != p.placement_id
+                    and q.shard_state == "active"
+                    and (n := self.nodes.get(q.node_id)) is not None
+                    and n.is_active and q.node_id != node.node_id]
+                if not survivors:
+                    raise CatalogError(
+                        f"cannot remove node {name!r}: it hosts the only "
+                        f"active placement of shard {p.shard_id}; "
+                        "rebalance or add replicas first")
+            # every distributed shard has a surviving replica: drop this
+            # node's placements (plus reference-table replicas and
+            # to_delete leftovers) so no placement dangles on a dead node
             self.placements = {k: p for k, p in self.placements.items()
                                if p.node_id != node.node_id}
             del self.nodes[node.node_id]
+            self._bump()
+
+    def disable_node(self, name: str) -> None:
+        """citus_disable_node analogue: mark unreachable; reads fail over
+        to replica placements immediately, placements stay recorded."""
+        with self._lock:
+            node = self.node_by_name(name)
+            node.is_active = False
+            self._bump()
+
+    def activate_node(self, name: str) -> None:
+        """citus_activate_node analogue."""
+        with self._lock:
+            node = self.node_by_name(name)
+            node.is_active = True
             self._bump()
 
     def node_by_name(self, name: str) -> NodeMetadata:
@@ -305,10 +333,19 @@ class Catalog:
                           key=lambda p: p.placement_id)
 
     def active_placement(self, shard_id: int) -> ShardPlacement:
+        """Primary placement for reads: the lowest-id active placement
+        whose NODE is alive.  With replicated placements this IS the
+        read failover — disabling a node silently shifts every affected
+        shard to its next replica (the reference interleaves failover
+        into task execution instead, adaptive_executor.c:95-116)."""
         ps = self.shard_placements(shard_id)
-        if not ps:
-            raise CatalogError(f"shard {shard_id} has no active placement")
-        return ps[0]
+        live = [p for p in ps
+                if (n := self.nodes.get(p.node_id)) is not None
+                and n.is_active]
+        if not live:
+            raise CatalogError(
+                f"shard {shard_id} has no active placement on a live node")
+        return live[0]
 
     def colocated_tables(self, name: str) -> list[str]:
         t = self.table(name)
@@ -323,7 +360,8 @@ class Catalog:
     #    operations/create_shards.c:83) --------------------------------------
     def create_distributed_table(
             self, name: str, schema: TableSchema, distribution_column: str,
-            shard_count: int, colocate_with: str | None = None) -> TableMetadata:
+            shard_count: int, colocate_with: str | None = None,
+            replication_factor: int = 1) -> TableMetadata:
         with self._lock:
             if not self.active_nodes():
                 raise CatalogError("no active nodes; call add_node first")
@@ -344,19 +382,26 @@ class Catalog:
             meta = TableMetadata(name, schema, DistributionMethod.HASH,
                                  distribution_column, group.colocation_id)
             nodes = self.active_nodes()
+            factor = max(1, min(replication_factor, len(nodes)))
             shards, placements = [], []
             for i, (lo, hi) in enumerate(shard_interval_bounds(shard_count)):
                 sid = self.allocate_shard_id()
                 shards.append(ShardInterval(sid, name, i, lo, hi))
-                # round-robin placement (CreateShardsWithRoundRobinPolicy), or
-                # aligned with the colocated table's placements
+                # round-robin placement (CreateShardsWithRoundRobinPolicy)
+                # with replicas on the next distinct nodes
+                # (citus.shard_replication_factor semantics); colocated
+                # tables copy the sibling shard's full placement node list
                 if colocate_with:
                     sibling = self.table_shards(colocate_with)[i]
-                    node_id = self.active_placement(sibling.shard_id).node_id
+                    node_ids = [p.node_id
+                                for p in self.shard_placements(
+                                    sibling.shard_id)]
                 else:
-                    node_id = nodes[i % len(nodes)].node_id
-                placements.append(ShardPlacement(
-                    self.allocate_placement_id(), sid, node_id))
+                    node_ids = [nodes[(i + r) % len(nodes)].node_id
+                                for r in range(factor)]
+                for node_id in node_ids:
+                    placements.append(ShardPlacement(
+                        self.allocate_placement_id(), sid, node_id))
             self.register_table(meta, shards, placements)
             return meta
 

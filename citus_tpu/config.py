@@ -51,6 +51,13 @@ _register(ConfigVar(
     "(ref: citus.shard_count, shared_library_init.c:2616).",
     int, min_value=1, max_value=64000))
 
+_register(ConfigVar(
+    "shard_replication_factor", 1,
+    "Placements per shard on distinct nodes; reads fail over to the next "
+    "replica when a node is disabled/removed "
+    "(ref: citus.shard_replication_factor, shared_library_init.c).",
+    int, min_value=1, max_value=64))
+
 # --- executor -------------------------------------------------------------
 _register(ConfigVar(
     "enable_repartition_joins", True,

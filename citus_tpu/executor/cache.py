@@ -23,6 +23,7 @@ Both caches are LRU-bounded (plans by entry count, feeds by device bytes).
 
 from __future__ import annotations
 
+import threading
 from collections import OrderedDict
 from dataclasses import dataclass, field
 
@@ -114,33 +115,40 @@ def feeds_signature(plan: QueryPlan, feeds) -> tuple:
 
 
 class PlanCache:
-    """LRU cache of jitted executables keyed by plan fingerprint."""
+    """LRU cache of jitted executables keyed by plan fingerprint.
+
+    Thread-safe: concurrent sessions threads race get/put (two threads
+    compiling the same new plan is wasted work, never wrong results)."""
 
     def __init__(self, max_entries: int = 256):
         self.max_entries = max_entries
         self._entries: OrderedDict[tuple, object] = OrderedDict()
+        self._lock = threading.Lock()
         self.hits = 0
         self.misses = 0
 
     def get(self, key: tuple):
-        fn = self._entries.get(key)
-        if fn is not None:
-            self._entries.move_to_end(key)
-            self.hits += 1
-        else:
-            self.misses += 1
-        return fn
+        with self._lock:
+            fn = self._entries.get(key)
+            if fn is not None:
+                self._entries.move_to_end(key)
+                self.hits += 1
+            else:
+                self.misses += 1
+            return fn
 
     def put(self, key: tuple, fn) -> None:
         if self.max_entries <= 0:
             return
-        self._entries[key] = fn
-        self._entries.move_to_end(key)
-        while len(self._entries) > self.max_entries:
-            self._entries.popitem(last=False)
+        with self._lock:
+            self._entries[key] = fn
+            self._entries.move_to_end(key)
+            while len(self._entries) > self.max_entries:
+                self._entries.popitem(last=False)
 
     def clear(self) -> None:
-        self._entries.clear()
+        with self._lock:
+            self._entries.clear()
 
     def __len__(self):
         return len(self._entries)
@@ -159,47 +167,56 @@ class CachedFeed:
 
 
 class FeedCache:
-    """LRU byte-bounded cache of device-resident table feeds."""
+    """LRU byte-bounded cache of device-resident table feeds.
+
+    Thread-safe; an evicted entry's arrays stay alive for any thread
+    already holding them (jax arrays are reference-counted)."""
 
     def __init__(self, max_bytes: int = 4 << 30):
         self.max_bytes = max_bytes
         self._entries: OrderedDict[tuple, CachedFeed] = OrderedDict()
+        self._lock = threading.Lock()
         self._total_bytes = 0
         self.hits = 0
         self.misses = 0
 
     def get(self, key: tuple) -> CachedFeed | None:
-        e = self._entries.get(key)
-        if e is not None:
-            self._entries.move_to_end(key)
-            self.hits += 1
-        else:
-            self.misses += 1
-        return e
+        with self._lock:
+            e = self._entries.get(key)
+            if e is not None:
+                self._entries.move_to_end(key)
+                self.hits += 1
+            else:
+                self.misses += 1
+            return e
 
     def put(self, key: tuple, feed: CachedFeed) -> None:
         if self.max_bytes <= 0:
             return
-        if key in self._entries:
-            self._total_bytes -= self._entries.pop(key).nbytes
-        self._entries[key] = feed
-        self._total_bytes += feed.nbytes
-        while self._total_bytes > self.max_bytes and len(self._entries) > 1:
-            _, old = self._entries.popitem(last=False)
-            self._total_bytes -= old.nbytes
+        with self._lock:
+            if key in self._entries:
+                self._total_bytes -= self._entries.pop(key).nbytes
+            self._entries[key] = feed
+            self._total_bytes += feed.nbytes
+            while self._total_bytes > self.max_bytes \
+                    and len(self._entries) > 1:
+                _, old = self._entries.popitem(last=False)
+                self._total_bytes -= old.nbytes
 
     def invalidate_table(self, table: str, keep_version: int | None = None
                          ) -> None:
         """Drop entries for `table` (key layout: (table, version, ...));
         keep_version spares the current version's entries."""
-        stale = [k for k in self._entries
-                 if k[0] == table and k[1] != keep_version]
-        for k in stale:
-            self._total_bytes -= self._entries.pop(k).nbytes
+        with self._lock:
+            stale = [k for k in self._entries
+                     if k[0] == table and k[1] != keep_version]
+            for k in stale:
+                self._total_bytes -= self._entries.pop(k).nbytes
 
     def clear(self) -> None:
-        self._entries.clear()
-        self._total_bytes = 0
+        with self._lock:
+            self._entries.clear()
+            self._total_bytes = 0
 
     @property
     def total_bytes(self) -> int:

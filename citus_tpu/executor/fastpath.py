@@ -40,6 +40,11 @@ def fast_path_shape(plan: QueryPlan, catalog) -> bool:
         if isinstance(node, JoinNode):
             if node.join_type not in ("inner", "left"):
                 return False
+            # same restriction the device compiler enforces — float keys
+            # must raise PlanningError there, not silently truncate here
+            for e in (*node.left_keys, *node.right_keys):
+                if e.dtype.value in ("float32", "float64"):
+                    return False
         elif isinstance(node, ScanNode):
             meta = catalog.table(node.rel.table)
             if meta.method == DistributionMethod.HASH:

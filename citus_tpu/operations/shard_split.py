@@ -103,7 +103,14 @@ def split_shard_by_split_points(session, shard_id: int,
         with catalog._lock:
             for t in group_tables:
                 parent = plan[t]["parent"]
-                node_id = catalog.active_placement(parent.shard_id).node_id
+                # children inherit the parent's FULL placement node list
+                # (primary first), so a configured replication factor
+                # survives the split
+                primary = catalog.active_placement(parent.shard_id)
+                parent_nodes = [primary.node_id] + [
+                    p.node_id for p in catalog.shard_placements(
+                        parent.shard_id)
+                    if p.placement_id != primary.placement_id]
                 pids = [p.placement_id
                         for p in catalog.placements.values()
                         if p.shard_id == parent.shard_id]
@@ -113,9 +120,10 @@ def split_shard_by_split_points(session, shard_id: int,
                 for cid, lo, hi in zip(plan[t]["children"], los, his):
                     catalog.shards[cid] = ShardInterval(
                         cid, t, 0, int(lo), int(hi))
-                    pid = catalog.allocate_placement_id()
-                    catalog.placements[pid] = ShardPlacement(pid, cid,
-                                                             node_id)
+                    for node_id in parent_nodes:
+                        pid = catalog.allocate_placement_id()
+                        catalog.placements[pid] = ShardPlacement(
+                            pid, cid, node_id)
                 # renumber shard_index by token order
                 for i, s in enumerate(sorted(
                         (s for s in catalog.shards.values()

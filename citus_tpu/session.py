@@ -42,7 +42,8 @@ from .storage import TableStore
 from .types import ColumnDef, DataType, TableSchema, sql_type_to_datatype
 
 _UDFS = ("create_distributed_table", "create_reference_table",
-         "citus_add_node", "citus_remove_node", "rebalance_table_shards",
+         "citus_add_node", "citus_remove_node", "citus_disable_node",
+         "citus_activate_node", "rebalance_table_shards",
          "citus_move_shard_placement", "citus_get_node_clock",
          "citus_stat_counters", "citus_stat_counters_reset",
          "citus_stat_statements", "citus_stat_statements_reset",
@@ -109,7 +110,9 @@ class Session:
         if not self.catalog.nodes:
             for i in range(self.n_devices):
                 self.catalog.add_node(f"device:{i}")
-        self._temp_counter = 0
+        import itertools
+
+        self._temp_counter = itertools.count(1)
         from .executor.runner import Executor
         from .stats import SessionStats
 
@@ -204,7 +207,9 @@ class Session:
         self.catalog.create_distributed_table(
             name, schema, distribution_column,
             shard_count or self.settings.get("shard_count"),
-            colocate_with=colocate_with)
+            colocate_with=colocate_with,
+            replication_factor=self.settings.get(
+                "shard_replication_factor"))
         self._save_catalog()
 
     def create_reference_table(self, name: str):
@@ -288,6 +293,12 @@ class Session:
             self._save_catalog()
         elif e.name == "citus_remove_node":
             self.catalog.remove_node(str(args[0]))
+            self._save_catalog()
+        elif e.name == "citus_disable_node":
+            self.catalog.disable_node(str(args[0]))
+            self._save_catalog()
+        elif e.name == "citus_activate_node":
+            self.catalog.activate_node(str(args[0]))
             self._save_catalog()
         elif e.name == "rebalance_table_shards":
             from .operations.rebalancer import rebalance_table_shards
@@ -423,13 +434,26 @@ class Session:
                 mon.advance(1, f"moved shard {mv.shard_id}")
             return run
 
+        # parallelize across nodes under a per-node concurrency cap of 1:
+        # a move depends only on the LAST earlier move touching either of
+        # its nodes (the reference's per-node task caps,
+        # citus.max_background_task_executors_per_node,
+        # utils/background_jobs.c)
         tasks = []
+        last_on_node: dict[int, int] = {}
         for i, mv in enumerate(moves):
-            # chain moves: catalog mutations serialize (the reference
-            # parallelizes across nodes under per-node caps)
+            # mv.source_node is the planner's SIMULATED source — correct
+            # even when one shard group moves twice in a plan (the live
+            # catalog only mutates as the background moves execute)
+            src = mv.source_node
+            deps = sorted({last_on_node[n]
+                           for n in (src, mv.target_node)
+                           if n in last_on_node})
             tasks.append((make_move(mv), f"move shard {mv.shard_id}",
-                          [i - 1] if i else []))
-        tasks.append((mon.finish, "finalize", [len(moves) - 1]))
+                          deps))
+            last_on_node[src] = i
+            last_on_node[mv.target_node] = i
+        tasks.append((mon.finish, "finalize", list(range(len(moves)))))
         job_id = self.jobs.submit_job("rebalance", tasks)
         self._last_rebalance_job = job_id
         return job_id
@@ -791,8 +815,9 @@ class Session:
         """Execute a subquery and store its rows as a temp reference table
         (the intermediate-result broadcast analogue)."""
         result = self._execute_subselect(sel)
-        self._temp_counter += 1
-        name = f"__intermediate_{self._temp_counter}"
+        # itertools.count is GIL-atomic — concurrent query threads must
+        # not mint the same intermediate-table name
+        name = f"__intermediate_{next(self._temp_counter)}"
         names = (list(column_names) if column_names
                  else result.column_names)
         cols = []

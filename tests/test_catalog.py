@@ -160,7 +160,7 @@ class TestCatalog:
     def test_remove_node_with_placements_blocked(self):
         cat = self._catalog_with_nodes(2)
         cat.create_distributed_table("orders", ORDERS, "o_orderkey", 4)
-        with pytest.raises(CatalogError, match="rebalance first"):
+        with pytest.raises(CatalogError, match="rebalance"):
             cat.remove_node("tpu:0")
 
     def test_duplicate_table_rejected(self):

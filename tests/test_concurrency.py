@@ -1,0 +1,91 @@
+"""Multi-query concurrency: overlapping execute() calls from several
+threads, concurrent with a background rebalance (VERDICT round-2 item 7;
+the reference's adaptive executor runs many tasks concurrently,
+executor/adaptive_executor.c:962)."""
+
+import threading
+
+import pytest
+
+import citus_tpu
+
+
+@pytest.fixture()
+def sess(tmp_path):
+    s = citus_tpu.connect(data_dir=str(tmp_path / "d"), n_devices=4,
+                          compute_dtype="float64")
+    s.execute("create table cq (k bigint, g bigint, v bigint)")
+    s.create_distributed_table("cq", "k", shard_count=8)
+    rows = [(i, i % 7, i * 3) for i in range(1, 1201)]
+    s.execute("insert into cq values "
+              + ",".join(str(t) for t in rows))
+    yield s
+    s.close()
+
+
+EXPECTED_SUM = sum(i * 3 for i in range(1, 1201))
+
+
+def _worker(sess, errors, n_iters):
+    try:
+        for i in range(n_iters):
+            r = sess.execute("select sum(v), count(*) from cq")
+            row = r.rows()[0]
+            assert int(row[0]) == EXPECTED_SUM and int(row[1]) == 1200
+            r2 = sess.execute(
+                f"select v from cq where k = {(i % 1200) + 1}")
+            assert int(r2.rows()[0][0]) == ((i % 1200) + 1) * 3
+            r3 = sess.execute(
+                "select g, count(*) from cq group by g order by g")
+            assert sum(int(x[1]) for x in r3.rows()) == 1200
+    except Exception as e:  # pragma: no cover - surfaced below
+        errors.append(e)
+
+
+def test_four_threads_with_background_rebalance(sess):
+    # skew placements so the rebalancer has real moves to make
+    nodes = sess.catalog.active_nodes()
+    for shard in sess.catalog.table_shards("cq")[:4]:
+        p = sess.catalog.active_placement(shard.shard_id)
+        p.node_id = nodes[0].node_id
+    sess.catalog._bump()
+
+    errors: list = []
+    threads = [threading.Thread(target=_worker,
+                                args=(sess, errors, 6))
+               for _ in range(4)]
+    for t in threads:
+        t.start()
+    job = sess.execute("select citus_rebalance_start()")
+    for t in threads:
+        t.join()
+    sess.execute("select citus_rebalance_wait()")
+    assert not errors, errors[0]
+    # post-rebalance correctness
+    r = sess.execute("select sum(v) from cq")
+    assert int(r.rows()[0][0]) == EXPECTED_SUM
+
+
+def test_parallel_rebalance_moves_not_fully_chained(sess):
+    """Moves touching disjoint node pairs must not depend on each other
+    (the reference parallelizes across nodes under per-node caps)."""
+    nodes = sess.catalog.active_nodes()
+    shards = sess.catalog.table_shards("cq")
+    # force all shards onto nodes 0 and 1 → moves target nodes 2 and 3
+    for i, shard in enumerate(shards):
+        p = sess.catalog.active_placement(shard.shard_id)
+        p.node_id = nodes[i % 2].node_id
+    sess.catalog._bump()
+    job_id = sess._start_background_rebalance()
+    assert job_id
+    sess.jobs.wait(job_id)
+    job = next(j for j in sess.jobs.jobs() if j.job_id == job_id)
+    move_tasks = sorted(job.tasks.values(),
+                        key=lambda t: t.task_id)[:-1]  # drop finalize
+    task_ids = [t.task_id for t in move_tasks]
+    # a pure chain means task i depends exactly on task i-1; the
+    # per-node scheduling must leave at least one move independent
+    chained = all(
+        t.depends_on == ((task_ids[i - 1],) if i else ())
+        for i, t in enumerate(move_tasks))
+    assert not chained or len(move_tasks) <= 1

@@ -87,3 +87,18 @@ def test_point_lookup_latency(sess):
     p50 = times[len(times) // 2]
     # VERDICT target: warm point lookup p50 < 5 ms
     assert p50 < 0.005, f"p50 {p50 * 1e3:.2f} ms"
+
+
+def test_float_join_keys_not_fast_pathed(sess):
+    from citus_tpu.errors import PlanningError
+
+    sess.execute("create table fa (k bigint, f double precision)")
+    sess.create_distributed_table("fa", "k", shard_count=4)
+    sess.execute("insert into fa values (1, 1.5)")
+    sess.execute("create table fr (f double precision, label text)")
+    sess.execute("select create_reference_table('fr')")
+    sess.execute("insert into fr values (1.25,'x'), (1.5,'y')")
+    # must behave exactly like the device path: reject float join keys
+    with pytest.raises(PlanningError, match="float join keys"):
+        sess.execute("select label from fa, fr where k = 1 "
+                     "and fa.f = fr.f")

@@ -1,0 +1,90 @@
+"""Replica placements + read failover (VERDICT round-2 missing item 1;
+reference: pg_dist_placement multiple placements per shard and the
+adaptive executor's read failover, adaptive_executor.c:95-116)."""
+
+import pytest
+
+import citus_tpu
+from citus_tpu.errors import CatalogError
+
+
+@pytest.fixture()
+def sess(tmp_path):
+    s = citus_tpu.connect(data_dir=str(tmp_path / "d"), n_devices=4,
+                          compute_dtype="float64",
+                          shard_replication_factor=2)
+    s.execute("create table r (k bigint, v bigint)")
+    s.create_distributed_table("r", "k", shard_count=8)
+    vals = ",".join(f"({i},{i * 2})" for i in range(1, 401))
+    s.execute(f"insert into r values {vals}")
+    yield s
+    s.close()
+
+
+def test_replicated_placements_created(sess):
+    for shard in sess.catalog.table_shards("r"):
+        ps = sess.catalog.shard_placements(shard.shard_id)
+        assert len(ps) == 2
+        assert len({p.node_id for p in ps}) == 2
+
+
+def test_failover_on_disable_node_mid_workload(sess):
+    total = int(sess.execute("select sum(v) from r").rows()[0][0])
+    assert total == sum(i * 2 for i in range(1, 401))
+    # kill a node (catalog-level): every query keeps answering correctly
+    victim = sess.catalog.active_nodes()[0].name
+    sess.execute(f"select citus_disable_node('{victim}')")
+    assert int(sess.execute("select sum(v) from r").rows()[0][0]) == total
+    assert int(sess.execute(
+        "select count(*) from r where k = 17").rows()[0][0]) == 1
+    # primary placements moved off the dead node
+    for shard in sess.catalog.table_shards("r"):
+        p = sess.catalog.active_placement(shard.shard_id)
+        assert sess.catalog.nodes[p.node_id].is_active
+    # node comes back: queries still correct
+    sess.execute(f"select citus_activate_node('{victim}')")
+    assert int(sess.execute("select sum(v) from r").rows()[0][0]) == total
+
+
+def test_remove_node_drops_replicas_keeps_answers(sess):
+    total = int(sess.execute("select sum(v) from r").rows()[0][0])
+    victim = sess.catalog.active_nodes()[-1].name
+    sess.execute(f"select citus_remove_node('{victim}')")
+    assert int(sess.execute("select sum(v) from r").rows()[0][0]) == total
+    # replication dropped to 1 for shards that had a replica there
+    counts = {len(sess.catalog.shard_placements(s.shard_id))
+              for s in sess.catalog.table_shards("r")}
+    assert counts <= {1, 2}
+    # removing another node that now holds sole placements must refuse
+    for other in list(sess.catalog.active_nodes()):
+        try:
+            sess.catalog.remove_node(other.name)
+        except CatalogError as e:
+            assert "only active placement" in str(e)
+            break
+    else:
+        pytest.fail("expected sole-placement removal to be refused")
+
+
+def test_unreplicated_node_removal_refused(tmp_path):
+    s = citus_tpu.connect(data_dir=str(tmp_path / "d"), n_devices=4,
+                          compute_dtype="float64")
+    s.execute("create table u (k bigint)")
+    s.create_distributed_table("u", "k", shard_count=4)
+    s.execute("insert into u values (1), (2), (3)")
+    victim = s.catalog.active_nodes()[0].name
+    with pytest.raises(CatalogError, match="only active placement"):
+        s.catalog.remove_node(victim)
+    s.close()
+
+
+def test_split_preserves_replication(sess):
+    shard = sess.catalog.table_shards("r")[0]
+    mid = (shard.min_value + shard.max_value) // 2
+    sess.execute(f"select citus_split_shard_by_split_points("
+                 f"{shard.shard_id}, '{mid}')")
+    for s in sess.catalog.table_shards("r"):
+        ps = sess.catalog.shard_placements(s.shard_id)
+        assert len(ps) == 2, f"shard {s.shard_id} lost its replica"
+    total = sum(i * 2 for i in range(1, 401))
+    assert int(sess.execute("select sum(v) from r").rows()[0][0]) == total
