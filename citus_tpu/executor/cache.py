@@ -34,6 +34,7 @@ from ..planner.plan import (
     ProjectNode,
     QueryPlan,
     ScanNode,
+    WindowNode,
 )
 
 
@@ -69,6 +70,11 @@ def node_fingerprint(node: PlanNode) -> str:
                 f"{[repr(k) for k in node.right_keys]};"
                 f"{node.residual!r};{node.left_match_filter!r};"
                 f"{node.right_match_filter!r};{_dist_sig(node.dist)})")
+    if isinstance(node, WindowNode):
+        fns = [(repr(w), cid) for w, cid in node.functions]
+        return (f"W({node.combine};{fns};"
+                f"{[repr(p) for p in node.partition_by]};"
+                f"{node_fingerprint(node.input)};{_dist_sig(node.dist)})")
     if isinstance(node, AggregateNode):
         groups = [(repr(g), cid) for g, cid in node.group_keys]
         aggs = [(repr(a), cid) for a, cid in node.aggs]

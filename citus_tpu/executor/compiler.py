@@ -42,6 +42,7 @@ from ..planner.plan import (
     ProjectNode,
     QueryPlan,
     ScanNode,
+    WindowNode,
 )
 from ..distributed.mesh import SHARD_AXIS
 from .batch import Block
@@ -305,6 +306,216 @@ class PlanCompiler:
         return blocks
 
     # ------------------------------------------------------------------
+    # -- window functions -----------------------------------------------
+    def _exec_window(self, node, feeds) -> Block:
+        """Partition-sorted segmented scans (the WindowAgg analogue).
+
+        Shuffle co-locates partitions (all_to_all by partition-key hash,
+        like the repartition join's map+fetch), then per distinct ORDER
+        BY spec: one lexsort + running segmented scans.  Results scatter
+        back to pre-sort row positions (unique indices — vectorized on
+        TPU), so the input block passes through unchanged with the
+        window columns appended."""
+        from ..ops.aggregate import _segmented_scan
+
+        blk = self._exec(node.input, feeds)
+        if node.combine == "repartition":
+            cap = self.caps.repartition[id(node)]
+            # routing keys with explicit NULL flags (zeroed value + flag),
+            # exactly like the aggregate combine shuffle: rows of a NULL
+            # partition must land on ONE device
+            karr = []
+            bsrc = _src(blk)
+            for p in node.partition_by:
+                v, nm = evaluate(p, bsrc, jnp)
+                v = jnp.broadcast_to(v, blk.valid.shape)
+                if jnp.issubdtype(v.dtype, jnp.floating):
+                    v = jax.lax.bitcast_convert_type(
+                        v, jnp.int32 if v.dtype == jnp.float32
+                        else jnp.int64)
+                v = v.astype(jnp.int64)
+                if nm is not None:
+                    nmb = jnp.broadcast_to(nm, blk.valid.shape)
+                    v = jnp.where(nmb, 0, v)
+                    karr.append(v)
+                    karr.append(nmb.astype(jnp.int64))
+                else:
+                    karr.append(v)
+            if not karr:
+                # one global partition: constant routing key
+                karr = [jnp.zeros(blk.valid.shape, jnp.int64)]
+            blk = self._repartition(blk, None, self.n_dev,
+                                    tuple(range(self.n_dev)), cap,
+                                    key_arrays=karr, valid=blk.valid)
+        n = blk.valid.shape[0]
+        src = _src(blk)
+
+        # partition keys (NULLs form their own partition, like GROUP BY):
+        # zero the value lane under NULL — the raw lane holds whatever
+        # the expression computed over garbage and would split the NULL
+        # partition
+        pkeys = []
+        for p in node.partition_by:
+            v, nm = evaluate(p, src, jnp)
+            v = jnp.broadcast_to(v, (n,))
+            if nm is not None:
+                nmb = jnp.broadcast_to(nm, (n,))
+                v = jnp.where(nmb, jnp.zeros((), v.dtype), v)
+                pkeys.append(v)
+                pkeys.append(nmb.astype(jnp.int32))
+            else:
+                pkeys.append(v)
+
+        # group functions by their ORDER BY spec: one sort per spec
+        by_order: dict[tuple, list] = {}
+        for w, cid in node.functions:
+            by_order.setdefault(w.order_by, []).append((w, cid))
+
+        out_cols = dict(blk.columns)
+        out_nulls = dict(blk.nulls)
+        iota = jnp.arange(n, dtype=jnp.int32)
+        for order_spec, fns in by_order.items():
+            okeys = []       # sort operands for the order keys
+            peer_keys = []   # equality keys defining rank peers
+            for e, desc in order_spec:
+                v, nm = evaluate(e, src, jnp)
+                v = jnp.broadcast_to(v, (n,))
+                nmb = (jnp.zeros(n, jnp.bool_) if nm is None
+                       else jnp.broadcast_to(nm, (n,)))
+                null_rank = (nmb if not desc else ~nmb).astype(jnp.int8)
+                # zero the lane under NULL FIRST: peers compare by
+                # (zeroed value, null flag) so all NULL rows tie
+                v = jnp.where(nmb, jnp.zeros((), v.dtype), v)
+                peer_keys.append(v)
+                peer_keys.append(nmb.astype(jnp.int8))
+                if desc:
+                    v = (-v if jnp.issubdtype(v.dtype, jnp.floating)
+                         else ~v)
+                okeys.append((null_rank, v))
+            operands = []
+            for null_rank, v in reversed(okeys):
+                operands.append(v)
+                operands.append(null_rank)
+            # lexsort, primary LAST: validity > partition keys > order keys
+            order = jnp.lexsort(tuple(operands)
+                                + tuple(reversed(pkeys))
+                                + ((~blk.valid).astype(jnp.int32),)
+                                ).astype(jnp.int32)
+            valid_s = blk.valid[order]
+
+            def shift_ne(a):
+                return jnp.concatenate([jnp.ones((1,), jnp.bool_),
+                                        a[1:] != a[:-1]])
+
+            pb = jnp.zeros(n, jnp.bool_)
+            for k in pkeys:
+                pb = pb | shift_ne(k[order])
+            if not pkeys:
+                pb = jnp.concatenate([jnp.ones((1,), jnp.bool_),
+                                      jnp.zeros(n - 1, jnp.bool_)])
+            part_boundary = pb | shift_ne(valid_s)  # invalid tail split off
+            peer_boundary = part_boundary
+            for k in peer_keys:
+                peer_boundary = peer_boundary | shift_ne(k[order])
+
+            # partition/peer start positions via running max over iota
+            part_start = jax.lax.cummax(
+                jnp.where(part_boundary, iota, jnp.int32(0)))
+            peer_start = jax.lax.cummax(
+                jnp.where(peer_boundary, iota, jnp.int32(0)))
+            # position of the LAST row of each peer group (running
+            # aggregates include peers)
+            peer_end = _seg_last(peer_boundary, iota)
+
+            for w, cid in fns:
+                res_s, null_s = self._window_value(
+                    w, blk, src, order, valid_s, part_boundary,
+                    peer_boundary, part_start, peer_start, peer_end,
+                    iota, _segmented_scan)
+                wcol = jnp.zeros(n, res_s.dtype).at[order].set(res_s)
+                out_cols[cid] = wcol
+                if null_s is not None:
+                    out_nulls[cid] = jnp.zeros(n, jnp.bool_) \
+                        .at[order].set(null_s)
+        return Block(out_cols, blk.valid, out_nulls)
+
+    def _window_value(self, w, blk, src, order, valid_s, part_boundary,
+                      peer_boundary, part_start, peer_start, peer_end,
+                      iota, seg_scan):
+        """One window function over the sorted view → (values, nulls)."""
+        n = valid_s.shape[0]
+        if w.kind == "row_number":
+            return (iota - part_start + 1).astype(jnp.int64), None
+        if w.kind == "rank":
+            return (peer_start - part_start + 1).astype(jnp.int64), None
+        if w.kind == "dense_rank":
+            c = jnp.cumsum(peer_boundary.astype(jnp.int32))
+            at_start = jax.lax.cummax(
+                jnp.where(part_boundary, c, jnp.int32(0)))
+            return (c - at_start + 1).astype(jnp.int64), None
+
+        # aggregate kinds: running (with ORDER BY, peers included) or
+        # whole-partition (without)
+        whole = not w.order_by
+        if w.kind == "count_star":
+            v = jnp.ones(n, jnp.int64)
+            contrib = valid_s
+        else:
+            raw, nm = evaluate(w.arg, src, jnp)
+            raw = jnp.broadcast_to(raw, (n,))[order]
+            contrib = valid_s if nm is None else (
+                valid_s & ~jnp.broadcast_to(nm, (n,))[order])
+            v = raw
+        kind = w.kind
+        if kind in ("count", "count_star"):
+            x = contrib.astype(jnp.int64)
+            scan = seg_scan(x, part_boundary, jnp.add)
+            res = scan[peer_end] if not whole else None
+            if whole:
+                res = self._partition_total(scan, part_boundary, n)
+            return res, None
+        if kind in ("sum", "avg"):
+            acc = (self.compute_dtype
+                   if jnp.issubdtype(v.dtype, jnp.floating)
+                   else jnp.int64)
+            x = jnp.where(contrib, v.astype(acc), jnp.zeros((), acc))
+            scan = seg_scan(x, part_boundary, jnp.add)
+            cnt = seg_scan(contrib.astype(jnp.int64), part_boundary,
+                           jnp.add)
+            if whole:
+                scan = self._partition_total(scan, part_boundary, n)
+                cnt = self._partition_total(cnt, part_boundary, n)
+            else:
+                scan = scan[peer_end]
+                cnt = cnt[peer_end]
+            if kind == "avg":
+                res = scan.astype(self.compute_dtype) / \
+                    jnp.maximum(cnt, 1).astype(self.compute_dtype)
+            else:
+                res = scan
+            return res, cnt == 0
+        if kind in ("min", "max"):
+            ident = _big(v.dtype) if kind == "min" else _small(v.dtype)
+            x = jnp.where(contrib, v, ident)
+            op = jnp.minimum if kind == "min" else jnp.maximum
+            scan = seg_scan(x, part_boundary, op)
+            cnt = seg_scan(contrib.astype(jnp.int64), part_boundary,
+                           jnp.add)
+            if whole:
+                scan = self._partition_total(scan, part_boundary, n)
+                cnt = self._partition_total(cnt, part_boundary, n)
+            else:
+                scan = scan[peer_end]
+                cnt = cnt[peer_end]
+            return scan, cnt == 0
+        raise ExecutionError(f"bad window kind {w.kind}")
+
+    @staticmethod
+    def _partition_total(scan, part_boundary, n):
+        """Broadcast each partition's LAST scan value to all its rows."""
+        iota = jnp.arange(n, dtype=jnp.int32)
+        return scan[_seg_last(part_boundary, iota)]
+
     def _exec(self, node: PlanNode, feeds: dict[int, Block]) -> Block:
         if isinstance(node, ScanNode):
             blk = feeds[id(node)]
@@ -321,6 +532,8 @@ class PlanCompiler:
             return self._project(blk, node.exprs)
         if isinstance(node, JoinNode):
             return self._exec_join(node, feeds)
+        if isinstance(node, WindowNode):
+            return self._exec_window(node, feeds)
         if isinstance(node, AggregateNode):
             return self._exec_aggregate(node, feeds)
         raise ExecutionError(f"unknown plan node {type(node).__name__}")
@@ -1149,6 +1362,15 @@ class PlanCompiler:
         for (a, cid), r in zip(node.aggs, res):
             cols[cid] = r
         return Block(cols, gvalid, nulls)
+
+
+def _seg_last(boundary: jnp.ndarray, iota: jnp.ndarray) -> jnp.ndarray:
+    """Per row: position of the LAST row of its segment (boundary marks
+    segment STARTS) — reverse running-min over next-boundary positions."""
+    n = iota.shape[0]
+    nb = jnp.concatenate([boundary[1:], jnp.ones((1,), jnp.bool_)])
+    return jnp.flip(jax.lax.cummin(
+        jnp.flip(jnp.where(nb, iota, jnp.int32(n - 1)))))
 
 
 def _src(blk: Block) -> ColumnSource:

@@ -27,6 +27,7 @@ from ..planner.plan import (
     ProjectNode,
     QueryPlan,
     ScanNode,
+    WindowNode,
 )
 from ..storage import TableStore
 from ..distributed.mesh import put_replicated, put_sharded
@@ -38,7 +39,7 @@ def walk_plan(node: PlanNode):
     if isinstance(node, JoinNode):
         yield from walk_plan(node.left)
         yield from walk_plan(node.right)
-    elif isinstance(node, (AggregateNode, ProjectNode)):
+    elif isinstance(node, (AggregateNode, ProjectNode, WindowNode)):
         yield from walk_plan(node.input)
 
 

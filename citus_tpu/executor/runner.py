@@ -25,6 +25,7 @@ from ..planner.plan import (
     ProjectNode,
     QueryPlan,
     ScanNode,
+    WindowNode,
 )
 from ..storage import TableStore
 from ..types import DataType, days_to_date
@@ -281,6 +282,18 @@ class Executor:
                     # the unmatched-build segment appends rcap fixed slots
                     out = out + rcap
                 return out
+            if isinstance(node, WindowNode):
+                in_cap = cap_of(node.input)
+                if node.combine != "repartition":
+                    return in_cap
+                if node.partition_by:
+                    repart[id(node)] = _round_cap(
+                        int(in_cap * repart_factor))
+                else:
+                    # one global partition: every row on one device
+                    repart[id(node)] = _round_cap(
+                        int(in_cap * n_dev * repart_factor))
+                return n_dev * repart[id(node)]
             if isinstance(node, AggregateNode):
                 in_cap = cap_of(node.input)
                 if node.combine == "global":

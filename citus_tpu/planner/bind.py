@@ -441,8 +441,12 @@ class Binder:
         return ir.BCmp(op, self._coerce(left, dtype),
                        self._coerce(right, dtype))
 
+    _WINDOW_ONLY = ("row_number", "rank", "dense_rank")
+
     def _bind_func(self, e: ast.FuncCall, scope: "_Scope",
                    allow_agg: bool) -> ir.BExpr:
+        if e.window is not None or e.name in self._WINDOW_ONLY:
+            return self._bind_window(e, scope, allow_agg)
         if e.name in ast.AGGREGATE_FUNCS:
             if not allow_agg:
                 raise PlanningError("aggregate not allowed here")
@@ -461,6 +465,47 @@ class Binder:
                 return ir.BAgg("sum", arg, e.distinct, DataType.INT64)
             return ir.BAgg(e.name, arg, e.distinct, DataType.FLOAT64)
         raise PlanningError(f"unsupported function {e.name!r}")
+
+    def _bind_window(self, e: ast.FuncCall, scope: "_Scope",
+                     allow_agg: bool) -> ir.BExpr:
+        """OVER (...) call → BWindow (planned into a WindowNode)."""
+        if not allow_agg:
+            raise PlanningError(
+                "window functions are not allowed here")
+        if e.window is None:
+            raise PlanningError(f"{e.name}() requires an OVER clause")
+        if e.distinct:
+            raise PlanningError("DISTINCT window aggregates are not "
+                                "supported")
+        part = tuple(self.bind_expr(p, scope, allow_agg=False)
+                     for p in e.window.partition_by)
+        order = tuple((self.bind_expr(o, scope, allow_agg=False), d)
+                      for o, d in e.window.order_by)
+        if e.name in self._WINDOW_ONLY:
+            if e.args or e.star:
+                raise PlanningError(f"{e.name}() takes no arguments")
+            if not order:
+                raise PlanningError(
+                    f"{e.name}() requires ORDER BY in its OVER clause")
+            return ir.BWindow(e.name, None, part, order, DataType.INT64)
+        if e.name not in ast.AGGREGATE_FUNCS:
+            raise PlanningError(
+                f"unsupported window function {e.name!r}")
+        if e.star and e.name != "count":
+            raise PlanningError(f"{e.name}(*) is not a valid window call")
+        if e.name == "count" and (e.star or not e.args):
+            return ir.BWindow("count_star", None, part, order,
+                              DataType.INT64)
+        if len(e.args) != 1:
+            raise PlanningError(f"{e.name} takes exactly one argument")
+        arg = self.bind_expr(e.args[0], scope, allow_agg=False)
+        if e.name == "count":
+            return ir.BWindow("count", arg, part, order, DataType.INT64)
+        if e.name in ("min", "max"):
+            return ir.BWindow(e.name, arg, part, order, arg.dtype)
+        if e.name == "sum" and arg.dtype.type_class.value == "int":
+            return ir.BWindow("sum", arg, part, order, DataType.INT64)
+        return ir.BWindow(e.name, arg, part, order, DataType.FLOAT64)
 
     # -- helpers -----------------------------------------------------------
     def _coerce(self, e: ir.BExpr, dtype: DataType) -> ir.BExpr:

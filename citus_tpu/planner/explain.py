@@ -9,7 +9,15 @@ and EXPLAIN ANALYZE appends wall-clock + retry stats from the runner.
 from __future__ import annotations
 
 from ..catalog import Catalog
-from .plan import AggregateNode, JoinNode, PlanNode, ProjectNode, QueryPlan, ScanNode
+from .plan import (
+    AggregateNode,
+    JoinNode,
+    PlanNode,
+    ProjectNode,
+    QueryPlan,
+    ScanNode,
+    WindowNode,
+)
 
 _JOIN_LABEL = {
     "local": "Colocated Join",
@@ -85,6 +93,13 @@ def _format_node(node: PlanNode, lines: list[str], depth: int) -> None:
             lines.append(f"{pad}     Residual: {node.residual}")
         _format_node(node.left, lines, depth + 1)
         _format_node(node.right, lines, depth + 1)
+        return
+    if isinstance(node, WindowNode):
+        combine = {"local": "device-local partitions",
+                   "repartition": "all_to_all partitions"}[node.combine]
+        fns = ", ".join(str(w) for w, _ in node.functions)
+        lines.append(f"{pad}-> WindowAgg [{combine}] {fns}")
+        _format_node(node.input, lines, depth + 1)
         return
     if isinstance(node, AggregateNode):
         combine = {"local": "device-local groups",

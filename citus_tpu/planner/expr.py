@@ -154,6 +154,33 @@ class BAgg(BExpr):
         return f"{self.kind}({d}{self.arg})"
 
 
+@dataclass(frozen=True)
+class BWindow(BExpr):
+    """Window function call; planned into a WindowNode device stage.
+
+    kind: row_number | rank | dense_rank | sum | count | count_star |
+    min | max | avg.  The default SQL frame applies: with order_by,
+    running aggregate over RANGE UNBOUNDED PRECEDING..CURRENT ROW
+    (peers included); without, the whole partition."""
+
+    kind: str
+    arg: Optional[BExpr]
+    partition_by: tuple[BExpr, ...]
+    order_by: tuple[tuple[BExpr, bool], ...]   # (expr, descending)
+    dtype: DataType = DataType.INT64
+
+    def __str__(self):
+        a = "*" if self.arg is None else str(self.arg)
+        parts = []
+        if self.partition_by:
+            parts.append("partition by "
+                         + ", ".join(map(str, self.partition_by)))
+        if self.order_by:
+            parts.append("order by " + ", ".join(
+                f"{e}{' desc' if d else ''}" for e, d in self.order_by))
+        return f"{self.kind}({a}) over ({' '.join(parts)})"
+
+
 def expr_columns(e: BExpr) -> set[str]:
     """All BCol cids referenced."""
     out: set[str] = set()
@@ -186,6 +213,11 @@ def children(e: BExpr) -> tuple:
         return out
     if isinstance(e, BAgg):
         return (e.arg,) if e.arg is not None else ()
+    if isinstance(e, BWindow):
+        out = () if e.arg is None else (e.arg,)
+        out += e.partition_by
+        out += tuple(k for k, _ in e.order_by)
+        return out
     return ()
 
 

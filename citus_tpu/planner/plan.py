@@ -158,6 +158,23 @@ class ProjectNode(PlanNode):
     exprs: list[tuple[ir.BExpr, str]]           # (expr, out cid)
 
 
+@dataclass
+class WindowNode(PlanNode):
+    """Window-function stage: co-locate partitions, sort, segmented scan.
+
+    The partition-by axis maps onto the same shuffle machinery joins use
+    (reference: window pushdown in planner/query_pushdown_planning.c —
+    Citus requires the partition key to include the distribution column;
+    here non-aligned partitions repartition with all_to_all instead).
+    All functions share one partition_by (v1); functions with different
+    ORDER BY specs get separate device sorts over the same shuffle."""
+
+    input: PlanNode
+    functions: list[tuple["ir.BWindow", str]]   # (window, out cid)
+    partition_by: tuple = ()
+    combine: str = "local"        # local | repartition
+
+
 # --------------------------------------------------------------------------
 # planner context
 # --------------------------------------------------------------------------
@@ -285,6 +302,17 @@ class DistributedPlanner:
                                   outer_info)
 
         decode: dict[str, tuple[str, str]] = {}
+        has_window = any(
+            isinstance(n, ir.BWindow)
+            for e, _ in q.select for n in ir.walk(e)) or any(
+            isinstance(n, ir.BWindow)
+            for e, _, _ in q.order_by for n in ir.walk(e))
+        if has_window:
+            if q.is_aggregate or q.distinct:
+                raise PlanningError(
+                    "window functions over GROUP BY / DISTINCT queries "
+                    "are not supported yet")
+            joined, q = self._plan_window_stage(q, joined)
         if q.is_aggregate or q.distinct:
             root, host_select, having, host_order = self._plan_aggregate(
                 q, joined, decode)
@@ -1116,6 +1144,54 @@ class DistributedPlanner:
                 return input_node.est_rows
         return max(1, est)
 
+    def _plan_window_stage(self, q: BoundQuery, input_node: PlanNode
+                           ) -> tuple[PlanNode, BoundQuery]:
+        """Extract window functions into a WindowNode; select/order then
+        reference its output columns (w0, w1, …)."""
+        from dataclasses import replace as dc_replace
+
+        windows: list[tuple[ir.BWindow, str]] = []
+        wmap: dict[ir.BWindow, ir.BCol] = {}
+
+        def rewrite(e: ir.BExpr) -> ir.BExpr:
+            if isinstance(e, ir.BWindow):
+                if e not in wmap:
+                    cid = f"w{len(windows)}"
+                    windows.append((e, cid))
+                    wmap[e] = ir.BCol(cid, e.dtype)
+                return wmap[e]
+            return _rebuild(e, [rewrite(c) for c in ir.children(e)])
+
+        new_select = [(rewrite(e), n) for e, n in q.select]
+        new_order = [(rewrite(e), d, nf) for e, d, nf in q.order_by]
+        parts = {w.partition_by for w, _ in windows}
+        if len(parts) > 1:
+            raise PlanningError(
+                "all window functions in one query must share the same "
+                "PARTITION BY clause")
+        partition_by = next(iter(parts))
+        node = WindowNode(input=input_node, functions=windows,
+                          partition_by=partition_by)
+        p_cids = {p.cid for p in partition_by if isinstance(p, ir.BCol)}
+        if partition_by and input_node.dist.kind in ("hash", "device") \
+                and (input_node.dist.cids & p_cids):
+            node.combine = "local"   # partitions already device-disjoint
+        else:
+            # all_to_all by partition-key hash (an empty PARTITION BY is
+            # one global partition: every row routes to one device)
+            node.combine = "repartition"
+        if node.combine == "local":
+            node.dist = input_node.dist
+        elif len(partition_by) == 1 and p_cids:
+            node.dist = self.device_dist(frozenset(p_cids))
+        else:
+            node.dist = self.device_dist(frozenset())
+        node.est_rows = input_node.est_rows
+        node.out_columns = dict(input_node.out_columns)
+        for w, cid in windows:
+            node.out_columns[cid] = w.dtype
+        return node, dc_replace(q, select=new_select, order_by=new_order)
+
     def _plan_projection(self, q: BoundQuery, input_node: PlanNode,
                          decode: dict):
         exprs = []
@@ -1178,4 +1254,12 @@ def _rebuild(e: ir.BExpr, new_children: list[ir.BExpr]) -> ir.BExpr:
                       for i in range(n))
         else_r = new_children[2 * n] if len(new_children) > 2 * n else None
         return ir.BCase(whens, else_r, e.dtype)
+    if isinstance(e, ir.BWindow):
+        i = 0 if e.arg is None else 1
+        arg = None if e.arg is None else new_children[0]
+        np_ = len(e.partition_by)
+        part = tuple(new_children[i:i + np_])
+        order = tuple((c, d) for c, (_, d) in zip(
+            new_children[i + np_:], e.order_by))
+        return ir.BWindow(e.kind, arg, part, order, e.dtype)
     raise PlanningError(f"cannot rebuild {type(e).__name__}")

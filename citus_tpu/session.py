@@ -235,6 +235,8 @@ class Session:
             return self._execute_select(stmt)
         if isinstance(stmt, ast.CreateTable):
             return self._execute_create_table(stmt)
+        if isinstance(stmt, ast.AlterTable):
+            return self._execute_alter_table(stmt)
         if isinstance(stmt, ast.DropTable):
             return self._execute_drop_table(stmt)
         if isinstance(stmt, ast.InsertValues):
@@ -469,6 +471,77 @@ class Session:
                      for c in stmt.columns)
         self.catalog.create_local_table(stmt.name, TableSchema(cols))
         self._save_catalog()
+        return None
+
+    def _execute_alter_table(self, stmt: ast.AlterTable):
+        """ALTER TABLE ADD/DROP/RENAME COLUMN as manifest-level schema
+        evolution: stripes are immutable; columns added later read as
+        NULL from older stripes, dropped columns simply leave the schema
+        (reference: commands/alter_table.c — there a full table rewrite
+        or catalog-only change depending on the clause)."""
+        from .stats import counters as sc
+
+        meta = self.catalog.table(stmt.table)
+        schema = meta.schema
+        if stmt.action == "add_column":
+            if schema.has_column(stmt.column.name):
+                if stmt.if_not_exists:
+                    return None
+                raise CatalogError(
+                    f"column {stmt.column.name!r} already exists")
+            new_col = ColumnDef(stmt.column.name,
+                                sql_type_to_datatype(stmt.column.type_name),
+                                nullable=not stmt.column.not_null)
+            if stmt.column.not_null and \
+                    self.store.table_row_count(stmt.table) > 0:
+                raise CatalogError(
+                    "cannot add a NOT NULL column to a non-empty table "
+                    "(existing rows would hold NULL)")
+            # guard against resurrecting a dropped/renamed-away column's
+            # on-disk data under the new name
+            self.store.register_column(stmt.table, new_col.name)
+            new_schema = TableSchema(schema.columns + (new_col,))
+        elif stmt.action == "drop_column":
+            if not schema.has_column(stmt.column_name):
+                if stmt.if_exists:
+                    return None
+                raise CatalogError(
+                    f"column {stmt.column_name!r} does not exist")
+            if meta.method == DistributionMethod.HASH and \
+                    stmt.column_name == meta.distribution_column:
+                raise CatalogError(
+                    "cannot drop the distribution column")
+            new_schema = TableSchema(tuple(
+                c for c in schema.columns if c.name != stmt.column_name))
+            if not new_schema.columns:
+                raise CatalogError("cannot drop the last column")
+            self.store.retire_column(stmt.table, stmt.column_name)
+        elif stmt.action == "rename_column":
+            if not schema.has_column(stmt.column_name):
+                raise CatalogError(
+                    f"column {stmt.column_name!r} does not exist")
+            if schema.has_column(stmt.new_name):
+                raise CatalogError(
+                    f"column {stmt.new_name!r} already exists")
+            if meta.method == DistributionMethod.HASH and \
+                    stmt.column_name == meta.distribution_column:
+                meta.distribution_column = stmt.new_name
+            new_schema = TableSchema(tuple(
+                ColumnDef(stmt.new_name if c.name == stmt.column_name
+                          else c.name, c.dtype, nullable=c.nullable)
+                for c in schema.columns))
+            # stripes keep the old on-disk name; the store records the
+            # mapping so reads/writes translate
+            self.store.rename_column(stmt.table, stmt.column_name,
+                                     stmt.new_name)
+        else:
+            raise UnsupportedQueryError(
+                f"ALTER TABLE {stmt.action} is not supported")
+        meta.schema = new_schema
+        self.catalog._bump()
+        self.store.bump_data_version(stmt.table)
+        self._save_catalog()
+        self.stats.counters.increment(sc.DDL_COMMANDS)
         return None
 
     def _execute_drop_table(self, stmt: ast.DropTable):
@@ -803,11 +876,18 @@ class Session:
                 (self._rewrite_expr(e.else_result, cleanup, cte_scope)
                  if e.else_result is not None else None))
         if isinstance(e, ast.FuncCall):
+            window = e.window
+            if window is not None:
+                window = ast.WindowSpec(
+                    tuple(self._rewrite_expr(p, cleanup, cte_scope)
+                          for p in window.partition_by),
+                    tuple((self._rewrite_expr(o, cleanup, cte_scope), d)
+                          for o, d in window.order_by))
             return ast.FuncCall(e.name,
                                 tuple(self._rewrite_expr(a, cleanup,
                                                          cte_scope)
                                       for a in e.args),
-                                e.distinct, e.star)
+                                e.distinct, e.star, window)
         return e
 
     def _materialize(self, sel: ast.Select, cleanup: list[str],

@@ -126,17 +126,38 @@ class Like(Expr):
 
 
 @dataclass(frozen=True)
+class WindowSpec(Node):
+    """OVER (PARTITION BY … ORDER BY …) clause."""
+
+    partition_by: tuple[Expr, ...] = ()
+    order_by: tuple[tuple[Expr, bool], ...] = ()   # (expr, descending)
+
+    def __str__(self):
+        parts = []
+        if self.partition_by:
+            parts.append("PARTITION BY "
+                         + ", ".join(map(str, self.partition_by)))
+        if self.order_by:
+            parts.append("ORDER BY " + ", ".join(
+                f"{e}{' DESC' if d else ''}" for e, d in self.order_by))
+        return f"OVER ({' '.join(parts)})"
+
+
+@dataclass(frozen=True)
 class FuncCall(Expr):
     name: str                 # lowercased
     args: tuple[Expr, ...]
     distinct: bool = False    # count(DISTINCT x)
     star: bool = False        # count(*)
+    window: WindowSpec | None = None   # OVER (...) → window function
 
     def __str__(self):
         if self.star:
-            return f"{self.name}(*)"
-        d = "DISTINCT " if self.distinct else ""
-        return f"{self.name}({d}{', '.join(map(str, self.args))})"
+            base = f"{self.name}(*)"
+        else:
+            d = "DISTINCT " if self.distinct else ""
+            base = f"{self.name}({d}{', '.join(map(str, self.args))})"
+        return f"{base} {self.window}" if self.window else base
 
 
 @dataclass(frozen=True)
@@ -382,6 +403,20 @@ class CreateTable(Statement):
 class DropTable(Statement):
     name: str
     if_exists: bool = False
+
+
+@dataclass(frozen=True)
+class AlterTable(Statement):
+    """ALTER TABLE … ADD/DROP/RENAME COLUMN (manifest-level schema
+    evolution; reference: commands/alter_table.c)."""
+
+    table: str
+    action: str                        # add_column | drop_column | rename_column
+    column: ColumnSpec | None = None   # for add_column
+    column_name: str = ""              # for drop/rename
+    new_name: str = ""                 # for rename_column
+    if_not_exists: bool = False        # ADD COLUMN IF NOT EXISTS
+    if_exists: bool = False            # DROP COLUMN IF EXISTS
 
 
 @dataclass(frozen=True)

@@ -62,6 +62,19 @@ class Parser:
         if not self.accept_keyword(word):
             self.error(f"expected {word.upper()}")
 
+    def accept_word(self, word: str) -> bool:
+        """Soft keyword: matches an ident OR keyword token by value, so
+        the word stays usable as a column name elsewhere."""
+        if self.cur.kind in ("ident", "keyword") and \
+                self.cur.value == word:
+            self.advance()
+            return True
+        return False
+
+    def expect_word(self, word: str) -> None:
+        if not self.accept_word(word):
+            self.error(f"expected {word.upper()}")
+
     def at_op(self, *ops: str) -> bool:
         return self.cur.kind == "op" and self.cur.value in ops
 
@@ -100,6 +113,10 @@ class Parser:
             return self.parse_select()
         if self.at_keyword("create"):
             return self.parse_create_table()
+        if self.cur.kind in ("ident", "keyword") and \
+                self.cur.value == "alter" and \
+                self.peek().value == "table":
+            return self.parse_alter_table()
         if self.at_keyword("drop"):
             return self.parse_drop_table()
         if self.at_keyword("insert"):
@@ -533,7 +550,8 @@ class Parser:
         self.expect_op("(")
         if self.accept_op("*"):
             self.expect_op(")")
-            return ast.FuncCall(name.lower(), (), star=True)
+            return self._maybe_over(ast.FuncCall(name.lower(), (),
+                                                 star=True))
         distinct = bool(self.accept_keyword("distinct"))
         args: list[ast.Expr] = []
         if not self.at_op(")"):
@@ -541,9 +559,72 @@ class Parser:
             while self.accept_op(","):
                 args.append(self.parse_expr())
         self.expect_op(")")
-        return ast.FuncCall(name.lower(), tuple(args), distinct=distinct)
+        return self._maybe_over(ast.FuncCall(name.lower(), tuple(args),
+                                             distinct=distinct))
+
+    def _maybe_over(self, call: ast.FuncCall) -> ast.FuncCall:
+        if not self.accept_word("over"):
+            return call
+        self.expect_op("(")
+        partition: list[ast.Expr] = []
+        order: list[tuple[ast.Expr, bool]] = []
+        if self.accept_word("partition"):
+            self.expect_keyword("by")
+            partition.append(self.parse_expr())
+            while self.accept_op(","):
+                partition.append(self.parse_expr())
+        if self.accept_keyword("order"):
+            self.expect_keyword("by")
+            while True:
+                e = self.parse_expr()
+                desc = bool(self.accept_keyword("desc"))
+                if not desc:
+                    self.accept_keyword("asc")
+                order.append((e, desc))
+                if not self.accept_op(","):
+                    break
+        self.expect_op(")")
+        from dataclasses import replace
+
+        return replace(call, window=ast.WindowSpec(
+            tuple(partition), tuple(order)))
 
     # -- DDL / DML ---------------------------------------------------------
+    def parse_alter_table(self) -> ast.AlterTable:
+        self.expect_word("alter")
+        self.expect_keyword("table")
+        table = self.expect_ident()
+        if self.accept_word("add"):
+            self.accept_word("column")
+            if_not_exists = False
+            if self.accept_keyword("if"):
+                self.expect_keyword("not")
+                self.expect_keyword("exists")
+                if_not_exists = True
+            spec = self._parse_column_spec()
+            return ast.AlterTable(table, "add_column", column=spec,
+                                  if_not_exists=if_not_exists)
+        if self.accept_keyword("drop"):
+            self.accept_word("column")
+            if_exists = False
+            if self.accept_keyword("if"):
+                self.expect_keyword("exists")
+                if_exists = True
+            name = self.expect_ident()
+            return ast.AlterTable(table, "drop_column", column_name=name,
+                                  if_exists=if_exists)
+        if self.accept_word("rename"):
+            if self.accept_word("column"):
+                old = self.expect_ident()
+                self.expect_word("to")
+                return ast.AlterTable(table, "rename_column",
+                                      column_name=old,
+                                      new_name=self.expect_ident())
+            self.expect_word("to")
+            return ast.AlterTable(table, "rename_table",
+                                  new_name=self.expect_ident())
+        self.error("expected ADD, DROP, or RENAME after ALTER TABLE")
+
     def parse_create_table(self) -> ast.CreateTable:
         self.expect_keyword("create")
         self.expect_keyword("table")

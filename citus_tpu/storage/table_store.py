@@ -145,7 +145,50 @@ class TableStore:
                 shutil.rmtree(self.table_dir(table))
 
     # -- dictionaries ------------------------------------------------------
+    def storage_column_name(self, table: str, column: str) -> str:
+        """Current column name → on-disk stripe/dictionary name (identity
+        unless ALTER TABLE RENAME COLUMN recorded a mapping)."""
+        return self.manifest(table).get("renames", {}).get(column, column)
+
+    def rename_column(self, table: str, old: str, new: str) -> None:
+        with self._write_lock(table), self._lock:
+            man = self.manifest(table)
+            renames = man.setdefault("renames", {})
+            storage = renames.pop(old, old)
+            renames[new] = storage
+            self._save_manifest(table)
+
+    def retire_column(self, table: str, column: str) -> None:
+        """DROP COLUMN bookkeeping: remember the on-disk name as dead so
+        a later ADD COLUMN with the same name can never resurrect the
+        dropped column's stripe data."""
+        with self._write_lock(table), self._lock:
+            man = self.manifest(table)
+            storage = man.setdefault("renames", {}).pop(column, column)
+            retired = man.setdefault("retired", [])
+            if storage not in retired:
+                retired.append(storage)
+            self._save_manifest(table)
+
+    def register_column(self, table: str, column: str) -> None:
+        """ADD COLUMN bookkeeping: if the name collides with a retired
+        storage name or another column's storage target (rename left the
+        old on-disk name in place), map the new column to a fresh
+        storage name instead."""
+        with self._write_lock(table), self._lock:
+            man = self.manifest(table)
+            renames = man.setdefault("renames", {})
+            used = set(man.get("retired", [])) | set(renames.values())
+            if column in used:
+                i = 2
+                while f"{column}__{i}" in used or \
+                        f"{column}__{i}" in renames.values():
+                    i += 1
+                renames[column] = f"{column}__{i}"
+                self._save_manifest(table)
+
     def dictionary(self, table: str, column: str) -> Dictionary:
+        column = self.storage_column_name(table, column)
         with self._lock:
             key = (table, column)
             if key not in self._dicts:
@@ -176,7 +219,16 @@ class TableStore:
 
         fault_point("store.append_stripe")
         meta = self.catalog.table(table)
-        schema_cols = [(c.name, c.dtype) for c in meta.schema.columns]
+        # new stripes write under STORAGE names so renamed columns stay
+        # consistent with pre-rename stripes
+        ren = self.manifest(table).get("renames", {})
+        if ren:
+            columns = {ren.get(c, c): a for c, a in columns.items()}
+            if validity is not None:
+                validity = {ren.get(c, c): a
+                            for c, a in validity.items()}
+        schema_cols = [(ren.get(c.name, c.name), c.dtype)
+                       for c in meta.schema.columns]
         with self._write_lock(table), self._lock:
             # Persist the bumped counter BEFORE writing the file so a crash +
             # reopen can never re-allocate (and overwrite) this stripe
@@ -389,6 +441,7 @@ class TableStore:
         cardinality estimation reads; ref: columnar chunk skip nodes,
         columnar/columnar_metadata.c).  None when no stripe carries stats
         (pre-stats files) or the column is all-NULL."""
+        column = self.storage_column_name(table, column)
         man = self.manifest(table)
         rec_lists = list(man["shards"].values())
         if self.overlay is not None:
@@ -427,6 +480,11 @@ class TableStore:
         """Concatenate all visible stripes of one shard (projected)."""
         meta = self.catalog.table(table)
         columns = columns or meta.schema.names
+        # translate renamed columns to their on-disk names for the
+        # stripe readers, but key all outputs by the REQUESTED names
+        storage_of = {c: self.storage_column_name(table, c)
+                      for c in columns}
+        requested_of = {s: c for c, s in storage_of.items()}
         man = self.manifest(table)
         records = (list(man["shards"].get(str(shard_id), []))
                    + self._overlay_records(table, shard_id))
@@ -438,8 +496,25 @@ class TableStore:
             dmask = self.effective_delete_mask(table, shard_id, rec)
             # a stripe with deletions reads whole (positions must align with
             # the bitmap), trading its chunk skipping for correctness
-            v, m, n = StripeReader(p).read(
-                columns, None if dmask is not None else chunk_filter)
+            reader = StripeReader(p)
+            # columns added by ALTER TABLE after this stripe was written
+            # read as all-NULL (schema evolution is manifest-level; old
+            # stripes are immutable)
+            present = [storage_of[c] for c in columns
+                       if storage_of[c] in reader._by_name]
+            missing = [c for c in columns
+                       if storage_of[c] not in reader._by_name]
+            if present or not missing:
+                v, m, n = reader.read(
+                    present, None if dmask is not None else chunk_filter)
+                v = {requested_of[s]: a for s, a in v.items()}
+                m = {requested_of[s]: a for s, a in m.items()}
+            else:  # projection of only post-ALTER columns
+                v, m, n = {}, {}, reader.row_count
+            for c in missing:
+                dt = meta.schema.column(c).dtype.numpy_dtype
+                v[c] = np.zeros(n, dtype=dt)
+                m[c] = np.zeros(n, dtype=np.bool_)
             if dmask is not None:
                 keep = ~dmask
                 v = {c: a[keep] for c, a in v.items()}
