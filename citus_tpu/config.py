@@ -103,6 +103,18 @@ _register(ConfigVar(
     "HBM byte budget for device-resident table feeds reused across "
     "queries (ref: connection/pool reuse, executor/adaptive_executor.c:962).",
     int, min_value=0, max_value=1 << 40))
+_register(ConfigVar(
+    "max_feed_bytes_per_device", 6 << 30,
+    "Per-device feed-byte ceiling before the executor streams the largest "
+    "scan in stripe batches (double-buffered stripe→HBM pipeline; the "
+    "resident path replaces the reference's per-stripe reader, "
+    "columnar/columnar_reader.c:323). 0 disables streaming.",
+    int, min_value=0, max_value=1 << 40))
+_register(ConfigVar(
+    "stream_batch_rows", 0,
+    "Fixed per-device rows per stream batch (0 = size from the "
+    "max_feed_bytes_per_device budget). Test/tuning knob.",
+    int, min_value=0, max_value=1 << 30))
 
 # --- columnar storage (ref: columnar GUCs + columnar.options catalog) -----
 _register(ConfigVar(

@@ -119,8 +119,12 @@ def _scan_host(executor, node: ScanNode):
     else:
         wanted = [shards[0]]
     colnames = [cid.split(".", 1)[1] for cid in node.columns]
-    chunk_filter = (make_chunk_filter(node.filter, executor.counters)
-                    if node.filter is not None else None)
+    chunk_filter = None
+    if node.filter is not None:
+        name_map = {c.name: executor.store.storage_column_name(
+            node.rel.table, c.name) for c in meta.schema.columns}
+        chunk_filter = make_chunk_filter(node.filter, executor.counters,
+                                         name_map)
     parts_v = {c: [] for c in colnames}
     parts_m = {c: [] for c in colnames}
     n = 0

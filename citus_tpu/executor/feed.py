@@ -82,7 +82,7 @@ def skippable_tests(filter_expr) -> tuple:
     return tuple(sorted(tests, key=repr))
 
 
-def make_chunk_filter(filter_expr, counters=None):
+def make_chunk_filter(filter_expr, counters=None, storage_name=None):
     """ScanNode filter → per-chunk min/max skip predicate.
 
     The chunk-granularity PruneShards analogue (reference:
@@ -91,10 +91,16 @@ def make_chunk_filter(filter_expr, counters=None):
     IN-lists (string predicates arrive as dictionary-code IN-lists from
     the binder); any unsatisfiable conjunct skips the whole chunk.
     Returns None when the filter has no skippable shape.
+
+    `storage_name` maps current → on-disk column names: stripe stats are
+    keyed by storage names, which diverge after ALTER TABLE RENAME.
     """
     tests = skippable_tests(filter_expr)
     if not tests:
         return None
+    if storage_name:
+        tests = tuple((storage_name.get(col, col), op, val)
+                      for col, op, val in tests)
 
     def chunk_filter(stats: dict) -> bool:
         for col, op, val in tests:
@@ -181,8 +187,11 @@ def _feed_scan(node: ScanNode, catalog: Catalog, store: TableStore,
     meta = catalog.table(rel.table)
     colnames = [cid.split(".", 1)[1] for cid in node.columns]
     shards = catalog.table_shards(rel.table)
-    chunk_filter = (make_chunk_filter(node.filter, counters)
-                    if node.filter is not None else None)
+    chunk_filter = None
+    if node.filter is not None:
+        name_map = {c.name: store.storage_column_name(rel.table, c.name)
+                    for c in meta.schema.columns}
+        chunk_filter = make_chunk_filter(node.filter, counters, name_map)
 
     if meta.method == DistributionMethod.HASH:
         per_dev_vals: list[dict[str, list[np.ndarray]]] = [

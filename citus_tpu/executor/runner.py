@@ -62,6 +62,7 @@ class ResultSet:
     retries: int = 0
     device_rows_scanned: int = 0
     fast_path: bool = False   # executed host-side via the fast-path router
+    streamed_batches: int = 0  # >0 ⇒ executed via the stream pipeline
     # per-column NULL masks (raw mode keeps typed arrays + mask instead of
     # objectified None entries); None when columns carry None directly
     null_masks: dict[str, np.ndarray] | None = None
@@ -103,6 +104,11 @@ class Executor:
         fast = try_execute_fast_path(self, plan, raw)
         if fast is not None:
             return fast
+        from .stream import try_execute_streamed
+
+        streamed = try_execute_streamed(self, plan, raw)
+        if streamed is not None:
+            return streamed
         compute_dtype = np.dtype(self.settings.get("compute_dtype"))
         feeds = build_feeds(plan, self.catalog, self.store, self.mesh,
                             compute_dtype, cache=self.feed_cache,
@@ -117,6 +123,25 @@ class Executor:
         memo = self._caps_memo.get(fingerprint)
         caps = (self._caps_from_order(plan, memo) if memo is not None
                 else self._initial_capacities(plan, feeds))
+        packed, out_meta, caps, retries = self.run_with_retry(
+            plan, feeds, caps, fingerprint, compute_dtype)
+        cols, nulls, valid = unpack_outputs(packed, out_meta)
+        result = self._host_combine(plan, cols, nulls, valid, raw)
+        result.retries = retries
+        # result-transfer volume in row slots (n_dev·cap, or n_dev·k under
+        # device top-k pushdown) — EXPLAIN ANALYZE / stats surface this
+        result.device_rows_scanned = int(np.asarray(valid).size)
+        return result
+
+    # ------------------------------------------------------------------
+    def run_with_retry(self, plan: QueryPlan, feeds, caps: Capacities,
+                       fingerprint, compute_dtype):
+        """Compile (or fetch cached) + execute + overflow-retry loop.
+
+        Shared by the resident-feed path and the streamed (batched)
+        path.  Returns (packed, out_meta, converged_caps, retries);
+        converged capacities are memoized under `fingerprint` whenever a
+        retry occurred so later executions start warm."""
         retries = 0
         while True:
             key = fingerprint + (caps_signature(plan, caps),)
@@ -143,7 +168,7 @@ class Executor:
                         self._caps_memo.clear()
                     self._caps_memo[fingerprint] = \
                         self._caps_to_order(plan, caps)
-                break
+                return packed, out_meta, caps, retries
             retries += 1
             if retries >= MAX_RETRIES:
                 raise CapacityOverflowError(
@@ -171,13 +196,6 @@ class Executor:
                               for k, v in fresh.scan_out.items()})
             if cap_overflow:
                 caps = caps.grown(cap_overflow)
-        cols, nulls, valid = unpack_outputs(packed, out_meta)
-        result = self._host_combine(plan, cols, nulls, valid, raw)
-        result.retries = retries
-        # result-transfer volume in row slots (n_dev·cap, or n_dev·k under
-        # device top-k pushdown) — EXPLAIN ANALYZE / stats surface this
-        result.device_rows_scanned = int(np.asarray(valid).size)
-        return result
 
     # ------------------------------------------------------------------
     @staticmethod

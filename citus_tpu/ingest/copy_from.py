@@ -145,18 +145,43 @@ def _ingest_batch(session, table: str, columns: list[str],
             if session.catalog.version == version:
                 break
             session.locks.release_all(lock_txid)
+        def write_one(i: int, s):
+            mask = shard_idx == i
+            if not bool(mask.any()):
+                return None
+            sub = {c: typed[c][mask] for c in typed}
+            subv = {c: validity[c][mask] for c in validity}
+            rec = session.store.append_stripe(
+                table, s.shard_id, sub, subv, codec=codec, level=level,
+                chunk_rows=chunk_rows, commit=False)
+            return (s.shard_id, rec)
+
         try:
-            for i, s in enumerate(shards):
-                mask = shard_idx == i
-                cnt = int(mask.sum())
-                if cnt == 0:
-                    continue
-                sub = {c: typed[c][mask] for c in typed}
-                subv = {c: validity[c][mask] for c in validity}
-                rec = session.store.append_stripe(
-                    table, s.shard_id, sub, subv, codec=codec, level=level,
-                    chunk_rows=chunk_rows, commit=False)
-                pending.append((s.shard_id, rec))
+            if n >= 65_536 and len(shards) > 1:
+                # per-shard stripe writes in parallel: compression and
+                # fsync release the GIL (the pipelined fan-out of the
+                # reference's per-shard COPY connections, multi_copy.c)
+                from concurrent.futures import ThreadPoolExecutor
+
+                with ThreadPoolExecutor(
+                        max_workers=min(8, len(shards))) as pool:
+                    futs = [pool.submit(write_one, i, s)
+                            for i, s in enumerate(shards)]
+                    err = None
+                    for f in futs:
+                        try:
+                            r = f.result()
+                            if r is not None:
+                                pending.append(r)
+                        except Exception as e:  # keep draining the pool
+                            err = err if err is not None else e
+                    if err is not None:
+                        raise err
+            else:
+                for i, s in enumerate(shards):
+                    r = write_one(i, s)
+                    if r is not None:
+                        pending.append(r)
             if commit:
                 session.store.commit_pending(table, pending)
                 pending = []
@@ -197,13 +222,25 @@ def _routing_tokens(session, table, column, dtype, values: np.ndarray):
 def _convert_column(session, table, name, dtype: DataType, cells,
                     pre_typed: bool):
     n = len(cells)
+    # bulk-load fast path: a numeric numpy column has no Nones by
+    # construction — skip the per-value validity scan entirely
+    if pre_typed and isinstance(cells, np.ndarray) \
+            and cells.dtype != object:
+        if dtype == DataType.STRING:
+            raise IngestError(
+                f"column {name!r}: string column fed a numeric array")
+        return (cells.astype(dtype.numpy_dtype, copy=False),
+                np.ones(n, dtype=bool))
     valid = np.array([c is not None and not (isinstance(c, str) and c == "")
                       if not pre_typed else c is not None
                       for c in cells], dtype=bool)
     if dtype == DataType.STRING:
         d = session.store.dictionary(table, name)
-        codes = d.intern_array([c if v else None
-                                for c, v in zip(cells, valid)])
+        if valid.all():
+            codes = d.intern_array(cells)
+        else:
+            codes = d.intern_array([c if v else None
+                                    for c, v in zip(cells, valid)])
         return codes, valid
     np_dtype = dtype.numpy_dtype
     out = np.zeros(n, dtype=np_dtype)

@@ -193,8 +193,10 @@ def generate_tables(sf: float, seed: int = 0) -> dict[str, dict[str, np.ndarray]
         "o_orderdate": odate.astype(np.int32),
         "o_orderpriority": np.array(PRIORITIES, dtype=object)[
             rng.integers(0, 5, no)],
-        "o_clerk": np.array([f"Clerk#{i:09d}" for i in
-                             rng.integers(1, max(ns, 2), no)], dtype=object),
+        "o_clerk": np.char.add(
+            "Clerk#", np.char.zfill(
+                rng.integers(1, max(ns, 2), no).astype("U9"), 9)
+        ).astype(object),
         "o_shippriority": np.zeros(no, dtype=np.int32),
         "o_comment": np.array([f"order comment {i}" for i in range(no)],
                               dtype=object),
@@ -204,7 +206,9 @@ def generate_tables(sf: float, seed: int = 0) -> dict[str, dict[str, np.ndarray]
     nl = int(per_order.sum())
     l_okey = np.repeat(okey, per_order)
     l_odate = np.repeat(odate, per_order)
-    linenumber = np.concatenate([np.arange(1, k + 1) for k in per_order])
+    # 1..k within each order, vectorized (global iota minus segment start)
+    starts = np.cumsum(per_order) - per_order
+    linenumber = np.arange(nl) - np.repeat(starts, per_order) + 1
     qty = rng.integers(1, 51, nl).astype(np.float64)
     pkey = rng.integers(1, npart + 1, nl).astype(np.int64)
     price_base = 900 + (pkey % 1000) * 0.1
@@ -273,10 +277,11 @@ def load_into_session(session, sf: float = 0.001, seed: int = 0,
         session.create_reference_table(table)
     for table, cols in data.items():
         names = list(cols.keys())
+        # numeric columns pass through as numpy (zero-copy ingest fast
+        # path); object (string) columns go as lists for interning
         batch = [list(cols[c]) if cols[c].dtype == object else cols[c]
                  for c in names]
-        counts[table] = _ingest_batch(session, table, names,
-                                      [list(b) for b in batch],
+        counts[table] = _ingest_batch(session, table, names, batch,
                                       pre_typed=True)[0]
     return counts
 

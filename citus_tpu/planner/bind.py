@@ -481,6 +481,14 @@ class Binder:
                      for p in e.window.partition_by)
         order = tuple((self.bind_expr(o, scope, allow_agg=False), d)
                       for o, d in e.window.order_by)
+        for o, _d in order:
+            if o.dtype == DataType.STRING:
+                # device sorts operate on dictionary CODES, which are in
+                # insertion order — ranking by them would be wrong.
+                # (PARTITION BY only needs equality, so codes are fine.)
+                raise PlanningError(
+                    "ORDER BY on a string column inside OVER (...) is "
+                    "not supported; order by a non-string key")
         if e.name in self._WINDOW_ONLY:
             if e.args or e.star:
                 raise PlanningError(f"{e.name}() takes no arguments")
@@ -499,6 +507,11 @@ class Binder:
         if len(e.args) != 1:
             raise PlanningError(f"{e.name} takes exactly one argument")
         arg = self.bind_expr(e.args[0], scope, allow_agg=False)
+        if arg.dtype == DataType.STRING and e.name != "count":
+            # min/max over codes would compare insertion order, and the
+            # output could not be decoded (no single source column)
+            raise PlanningError(
+                f"window {e.name}() over a string column is not supported")
         if e.name == "count":
             return ir.BWindow("count", arg, part, order, DataType.INT64)
         if e.name in ("min", "max"):

@@ -307,6 +307,11 @@ class DistributedPlanner:
             for e, _ in q.select for n in ir.walk(e)) or any(
             isinstance(n, ir.BWindow)
             for e, _, _ in q.order_by for n in ir.walk(e))
+        if q.having is not None and any(
+                isinstance(n, ir.BWindow) for n in ir.walk(q.having)):
+            # PG also rejects this (windows run after HAVING)
+            raise PlanningError(
+                "window functions are not allowed in HAVING")
         if has_window:
             if q.is_aggregate or q.distinct:
                 raise PlanningError(

@@ -33,6 +33,7 @@ INSERT_SELECT_PUSHDOWN = "insert_select_pushdown"
 INSERT_SELECT_REPARTITION = "insert_select_repartition"
 INSERT_SELECT_PULL = "insert_select_pull"
 CHUNKS_SKIPPED = "chunks_skipped"
+QUERIES_STREAMED = "queries_streamed"
 
 ALL_COUNTERS = [
     QUERIES_SINGLE_SHARD, QUERIES_MULTI_SHARD, QUERIES_REPARTITION,
@@ -41,7 +42,7 @@ ALL_COUNTERS = [
     DML_UPDATE, DML_DELETE, DML_MERGE, DDL_COMMANDS,
     CAPACITY_RETRIES, DEVICE_ROWS_SCANNED,
     INSERT_SELECT_PUSHDOWN, INSERT_SELECT_REPARTITION, INSERT_SELECT_PULL,
-    CHUNKS_SKIPPED,
+    CHUNKS_SKIPPED, QUERIES_STREAMED,
 ]
 
 

@@ -33,21 +33,23 @@ from .format import StripeReader, write_stripe
 
 def _column_stats(columns: dict[str, np.ndarray],
                   validity: dict[str, np.ndarray] | None) -> dict:
-    """Per-column [min, max] over non-NULL values (JSON-safe scalars)."""
+    """Per-column [min, max, null_count] over non-NULL values (JSON-safe
+    scalars).  Pre-null-count manifests hold 2-element entries — readers
+    must treat a missing third element as "may contain NULLs"."""
     out = {}
     for name, arr in columns.items():
-        if arr.dtype == object or arr.size == 0:
-            out[name] = [None, None]
-            continue
+        nulls = 0
         v = arr
         if validity is not None and name in validity:
-            v = arr[validity[name]]
-        if v.size == 0:
-            out[name] = [None, None]
+            val = validity[name]
+            nulls = int(len(val) - val.sum())
+            v = arr[val]
+        if arr.dtype == object or v.size == 0:
+            out[name] = [None, None, nulls]
         elif np.issubdtype(v.dtype, np.floating):
-            out[name] = [float(v.min()), float(v.max())]
+            out[name] = [float(v.min()), float(v.max()), nulls]
         else:
-            out[name] = [int(v.min()), int(v.max())]
+            out[name] = [int(v.min()), int(v.max()), nulls]
     return out
 
 
@@ -474,10 +476,12 @@ class TableStore:
                    for sid in set(man["shards"])
                    | {str(s) for t, s in self.overlay.records if t == table})
 
-    def read_shard(self, table: str, shard_id: int,
-                   columns: list[str] | None = None, chunk_filter=None,
-                   ) -> tuple[dict[str, np.ndarray], dict[str, np.ndarray], int]:
-        """Concatenate all visible stripes of one shard (projected)."""
+    def iter_shard_stripes(self, table: str, shard_id: int,
+                           columns: list[str] | None = None,
+                           chunk_filter=None):
+        """Yield (values, validity, live_rows) per visible stripe of one
+        shard — the streaming read path (batched stripe→HBM feeds consume
+        this one stripe at a time instead of materializing the shard)."""
         meta = self.catalog.table(table)
         columns = columns or meta.schema.names
         # translate renamed columns to their on-disk names for the
@@ -488,9 +492,6 @@ class TableStore:
         man = self.manifest(table)
         records = (list(man["shards"].get(str(shard_id), []))
                    + self._overlay_records(table, shard_id))
-        vals: dict[str, list[np.ndarray]] = {c: [] for c in columns}
-        mask: dict[str, list[np.ndarray]] = {c: [] for c in columns}
-        total = 0
         for rec in records:
             p = os.path.join(self.shard_dir(table, shard_id), rec["file"])
             dmask = self.effective_delete_mask(table, shard_id, rec)
@@ -520,6 +521,19 @@ class TableStore:
                 v = {c: a[keep] for c, a in v.items()}
                 m = {c: a[keep] for c, a in m.items()}
                 n = int(keep.sum())
+            yield v, m, n
+
+    def read_shard(self, table: str, shard_id: int,
+                   columns: list[str] | None = None, chunk_filter=None,
+                   ) -> tuple[dict[str, np.ndarray], dict[str, np.ndarray], int]:
+        """Concatenate all visible stripes of one shard (projected)."""
+        meta = self.catalog.table(table)
+        columns = columns or meta.schema.names
+        vals: dict[str, list[np.ndarray]] = {c: [] for c in columns}
+        mask: dict[str, list[np.ndarray]] = {c: [] for c in columns}
+        total = 0
+        for v, m, n in self.iter_shard_stripes(table, shard_id, columns,
+                                               chunk_filter):
             total += n
             for c in columns:
                 vals[c].append(v[c])

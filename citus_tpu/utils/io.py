@@ -14,13 +14,25 @@ def fsync_dir(path: str) -> None:
         os.close(dir_fd)
 
 
+def atomic_write_bytes(path: str, payload: bytes) -> None:
+    """tmp + fsync + rename + dir fsync: the durability primitive under
+    the catalog, manifests, and dictionaries."""
+    import tempfile
+
+    d = os.path.dirname(os.path.abspath(path))
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=".aw.")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(payload)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+    fsync_dir(d)
+
+
 def atomic_write_json(path: str, obj, indent: int | None = 1) -> None:
-    """tmp + fsync + rename + dir fsync: the durability primitive under the
-    catalog, manifests, and dictionaries."""
-    tmp = path + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump(obj, f, indent=indent)
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, path)
-    fsync_dir(os.path.dirname(os.path.abspath(path)))
+    atomic_write_bytes(path, json.dumps(obj, indent=indent).encode())

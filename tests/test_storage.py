@@ -151,6 +151,49 @@ class TestDictionary:
         assert toks[1] == string_hash_token("GERMANY")
         assert toks[0] != toks[1]
 
+    def test_bulk_native_multi_batch_consistency(self):
+        """The persistent native intern table must agree with the Python
+        fallback across multiple batches (incremental handle reuse), and
+        mixing single-value interns between batches must stay in sync."""
+        import numpy as np
+
+        vals1 = [f"v{i % 1500}" for i in range(6000)]
+        vals2 = [f"v{i % 2500}" for i in range(8000)]  # 1000 new + overlap
+        d_native = Dictionary()
+        d_ref = Dictionary()
+        c1n = d_native.intern_array(vals1)
+        # reference path: force the Python loop by tiny batches
+        c1r = np.concatenate([d_ref.intern_array(vals1[i:i + 100])
+                              for i in range(0, len(vals1), 100)])
+        assert np.array_equal(c1n, c1r)
+        # single-value intern in between (handle must re-sync)
+        assert d_native.intern("interloper") == d_ref.intern("interloper")
+        c2n = d_native.intern_array(vals2)
+        c2r = np.concatenate([d_ref.intern_array(vals2[i:i + 100])
+                              for i in range(0, len(vals2), 100)])
+        assert np.array_equal(c2n, c2r)
+        assert d_native.values == d_ref.values
+
+    def test_bulk_native_separator_fallback(self):
+        import numpy as np
+
+        vals = [("bad\x1fvalue" if i == 17 else f"s{i}")
+                for i in range(5000)]
+        d = Dictionary()
+        codes = d.intern_array(vals)  # must fall back, not corrupt
+        assert d.value_of(int(codes[17])) == "bad\x1fvalue"
+        assert len(np.unique(codes)) == len(set(vals))
+
+    def test_binary_persistence_large_roundtrip(self, tmp_path):
+        vals = [f"comment number {i}" for i in range(5000)]
+        d = Dictionary()
+        d.intern_array(vals)
+        p = str(tmp_path / "dict_big.json")
+        d.save(p)
+        d2 = Dictionary.load(p)
+        assert d2.values == vals
+        assert d2.code_of("comment number 4999") == 4999
+
 
 class TestTableStore:
     def _store(self, tmp_path, shard_count=4):

@@ -91,6 +91,33 @@ def test_window_restrictions(sess):
                   "from w group by g")
 
 
+def test_window_string_keys_rejected(tmp_path):
+    """Dictionary codes are insertion-ordered, not lexicographic: ranking
+    or min/max over a string column must be a planning error, not a
+    silently wrong answer.  PARTITION BY strings (equality only) works."""
+    s = citus_tpu.connect(data_dir=str(tmp_path / "ws"), n_devices=4,
+                          compute_dtype="float64")
+    try:
+        s.execute("create table ws (k bigint, name text, v bigint)")
+        s.create_distributed_table("ws", "k", shard_count=4)
+        s.execute("insert into ws values (1,'zeta',10),(2,'alpha',20),"
+                  "(3,'zeta',30),(4,'beta',40)")
+        with pytest.raises(PlanningError, match="string"):
+            s.execute("select rank() over (order by name) from ws")
+        with pytest.raises(PlanningError, match="string"):
+            s.execute("select min(name) over (partition by k) from ws")
+        # equality-only use of strings is fine
+        r = s.execute("select name, sum(v) over (partition by name) "
+                      "from ws order by name, sum")
+        assert [tuple(x) for x in r.rows()] == [
+            ("alpha", 20), ("beta", 40), ("zeta", 40), ("zeta", 40)]
+        # count over strings is order-insensitive → allowed
+        r = s.execute("select count(name) over (partition by k) from ws")
+        assert r.row_count == 4
+    finally:
+        s.close()
+
+
 def test_alter_table_add_drop_rename(sess):
     s, _ = sess
     s.execute("alter table w add column extra bigint")
