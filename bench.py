@@ -1,4 +1,5 @@
-"""Benchmark driver: the five BASELINE.json configs on one chip.
+"""Benchmark driver: the five BASELINE.json configs on one chip, plus the
+SF10 scale configs and a columnar-scan bandwidth line.
 
 Prints one JSON line per config; the LAST line is the headline metric
 (TPC-H Q1 scan-aggregate throughput), matching the driver contract of a
@@ -7,17 +8,21 @@ final `{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}` line.
 Baseline yardstick: the reference's only published absolute number — the
 columnar engine aggregating 75M rows in 16 s (≈4.69M rows/s) on a 2-vCPU
 Azure VM (/root/reference/src/backend/columnar/README.md:303-321).  Every
-config reports rows-processed/sec against that scan rate.
+rows/s config reports against that scan rate; the GB/s line reports
+against the same workload expressed in bytes (75M rows × 20 scanned
+bytes/row ≈ 0.088 GB/s).
 
 Configs (BASELINE.json):
   1. TPC-H Q1 scan + grouped aggregate over lineitem      [headline]
   2. co-located hash join (orders ⋈ lineitem on orderkey)
   3. single-repartition join (customer ⋈ orders on custkey)
-  4. dual-repartition join + global aggregate (psum combine)
-  5. TPC-H Q3 multi-join (repartition + colocated + grouped aggregate)
+  4. dual-repartition join + global aggregate (psum combine); also at SF10
+  5. TPC-H Q3 multi-join (repartition + colocated + grouped agg); also SF10
+  +  columnar cold-scan bandwidth (stripe read → HBM → aggregate)
 
 Env knobs: BENCH_SF (default 1.0), BENCH_REPEATS (default 3),
-BENCH_ONLY (comma list of config names to run).
+BENCH_ONLY (comma list of config names), BENCH_SF10 (default 1; 0 skips
+the SF10 section), BENCH_SF10_SCALE (default 10.0).
 """
 
 from __future__ import annotations
@@ -29,6 +34,10 @@ import tempfile
 import time
 
 BASELINE_ROWS_PER_SEC = 75_000_000 / 16.0  # reference columnar agg scan
+# the same reference scan in bytes: vendor_id int4 + quantity int8 ≈ 12
+# logical bytes/row, but the table had 8 more columns the row engine read;
+# charge the columnar engine only what it scanned (2 cols ≈ 12 B/row)
+BASELINE_SCAN_GB_PER_SEC = (75_000_000 * 12) / 16.0 / 1e9
 
 
 def bench_query(sess, sql: str, rows_processed: int, repeats: int):
@@ -43,17 +52,49 @@ def bench_query(sess, sql: str, rows_processed: int, repeats: int):
     return rows_processed / best, best
 
 
+def bench_cold_scan(sess, n_rows: int):
+    """Cold columnar scan: stripe read + decompress + pad + device_put +
+    aggregate, with the HBM feed cache emptied first (the plan stays
+    compiled — this measures the data path, not XLA)."""
+    sql = ("select sum(l_quantity), sum(l_extendedprice), "
+           "sum(l_discount), sum(l_tax) from lineitem")
+    sess.execute(sql)  # compile + warm
+    bytes_scanned = n_rows * 4 * 8  # four float64 columns as stored
+    best = float("inf")
+    for _ in range(2):
+        sess.executor.feed_cache.clear()
+        t0 = time.perf_counter()
+        r = sess.execute(sql)
+        best = min(best, time.perf_counter() - t0)
+        assert r.row_count == 1
+    return bytes_scanned / best / 1e9, best
+
+
 def main() -> None:
     sf = float(os.environ.get("BENCH_SF", "1.0"))
     repeats = int(os.environ.get("BENCH_REPEATS", "3"))
+    sf10 = os.environ.get("BENCH_SF10", "1") not in ("0", "false", "")
+    sf10_scale = float(os.environ.get("BENCH_SF10_SCALE", "10.0"))
     only = os.environ.get("BENCH_ONLY")
     only = set(only.split(",")) if only else None
 
     from citus_tpu.session import Session
     from citus_tpu.ingest.tpch import QUERIES, load_into_session
 
-    data_dir = tempfile.mkdtemp(prefix="citus_tpu_bench_")
     lines = []
+
+    def emit(name, rate, best, this_sf, unit="rows/s",
+             baseline=BASELINE_ROWS_PER_SEC):
+        lines.append({
+            "metric": name,
+            "value": round(rate, 3 if unit != "rows/s" else 1),
+            "unit": unit,
+            "vs_baseline": round(rate / baseline, 3),
+            "seconds": round(best, 4),
+            "sf": this_sf,
+        })
+
+    data_dir = tempfile.mkdtemp(prefix="citus_tpu_bench_")
     try:
         sess = Session(data_dir=data_dir)
         load_into_session(sess, sf=sf, seed=0)
@@ -76,20 +117,53 @@ def main() -> None:
              "where o_custkey = l_suppkey",
              n_ord + n_li),
             ("tpch_q3_rows_per_sec", QUERIES["Q3"], n_cust + n_ord + n_li),
-            ("tpch_q1_rows_per_sec", QUERIES["Q1"], n_li),  # headline LAST
         ]
         for name, sql, rows in configs:
             if only is not None and name not in only:
                 continue
             rate, best = bench_query(sess, sql, rows, repeats)
-            lines.append({
-                "metric": name,
-                "value": round(rate, 1),
-                "unit": "rows/s",
-                "vs_baseline": round(rate / BASELINE_ROWS_PER_SEC, 3),
-                "seconds": round(best, 4),
-                "sf": sf,
-            })
+            emit(name, rate, best, sf)
+        if only is None or "columnar_scan_gb_per_sec" in only:
+            rate, best = bench_cold_scan(sess, n_li)
+            emit("columnar_scan_gb_per_sec", rate, best, sf, unit="GB/s",
+                 baseline=BASELINE_SCAN_GB_PER_SEC)
+
+        # -- SF10 section (BASELINE config #4 at scale) -------------------
+        sf10_wanted = {"dual_repartition_join_sf10_rows_per_sec",
+                       "tpch_q3_sf10_rows_per_sec"}
+        sf10_run = (sf10_wanted if only is None
+                    else sf10_wanted & only) if sf10 else set()
+        if sf10_run:
+            sf10_dir = tempfile.mkdtemp(prefix="citus_tpu_bench_sf10_")
+            try:
+                s10 = Session(data_dir=sf10_dir)
+                load_into_session(
+                    s10, sf=sf10_scale, seed=0,
+                    tables={"customer", "orders", "lineitem"})
+                n_li10 = s10.store.table_row_count("lineitem")
+                n_ord10 = s10.store.table_row_count("orders")
+                n_cust10 = s10.store.table_row_count("customer")
+                if "dual_repartition_join_sf10_rows_per_sec" in sf10_run:
+                    rate, best = bench_query(
+                        s10,
+                        "select count(*) from orders, lineitem "
+                        "where o_custkey = l_suppkey",
+                        n_ord10 + n_li10, 1)
+                    emit("dual_repartition_join_sf10_rows_per_sec", rate,
+                         best, sf10_scale)
+                if "tpch_q3_sf10_rows_per_sec" in sf10_run:
+                    rate, best = bench_query(
+                        s10, QUERIES["Q3"], n_cust10 + n_ord10 + n_li10, 1)
+                    emit("tpch_q3_sf10_rows_per_sec", rate, best,
+                         sf10_scale)
+            finally:
+                shutil.rmtree(sf10_dir, ignore_errors=True)
+
+        # headline LAST (driver contract: final JSON line)
+        if only is None or "tpch_q1_rows_per_sec" in only:
+            rate, best = bench_query(sess, QUERIES["Q1"], n_li, repeats)
+            emit("tpch_q1_rows_per_sec", rate, best, sf)
+
         for line in lines:
             print(json.dumps(line))
         _publish(lines)
@@ -107,7 +181,8 @@ def _publish(lines) -> None:
         doc.setdefault("published", {})
         for line in lines:
             doc["published"][line["metric"]] = {
-                "rows_per_sec": line["value"],
+                f"{line['unit'].replace('/', '_per_')}":
+                    line["value"],
                 "vs_baseline": line["vs_baseline"],
                 "sf": line["sf"],
             }

@@ -1,0 +1,120 @@
+"""Kernel micro-benchmarks: Pallas vs XLA formulations on real hardware.
+
+Runs the dense-grid segment aggregation both ways across the (N, K)
+regimes the executor actually hits, prints a table, and says which
+implementation the executor should route to.  This is the measurement
+the BASELINE north star asks for — hand kernels where they win, measured
+justification where XLA already wins.
+
+Usage:  python bench_kernels.py          (real TPU)
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+REPS = 16
+
+
+def timeit(op, slot, values, repeats=3):
+    """Per-op device time via slope timing: the remote (axon) tunnel adds
+    ~100 ms of dispatch latency per round trip, so single executions are
+    latency-bound.  Run the op REPS times inside ONE jitted program (an
+    epsilon perturbation defeats CSE) and take (t_reps - t_once) / (R-1).
+    """
+
+    def many(s, v, r):
+        def body(i, acc):
+            out = op(s, v + i.astype(v.dtype) * jnp.float32(1e-30))
+            return acc + jnp.sum(out)
+
+        return jax.lax.fori_loop(0, r, body, jnp.float32(0.0))
+
+    f = jax.jit(many, static_argnums=2)
+    jax.device_get(f(slot, values, 1))
+    jax.device_get(f(slot, values, REPS))
+    t1 = tr = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        jax.device_get(f(slot, values, 1))
+        t1 = min(t1, time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        jax.device_get(f(slot, values, REPS))
+        tr = min(tr, time.perf_counter() - t0)
+    return max((tr - t1) / (REPS - 1), 1e-9)
+
+
+def xla_segment_sum(slot, values, total):
+    return jax.ops.segment_sum(values, slot, num_segments=total + 1)[:total]
+
+
+def xla_onehot_matmul(slot, values, total):
+    # the same one-hot trick expressed in plain XLA (no Pallas)
+    k_pad = -(-total // 512) * 512
+    onehot = (slot[:, None] ==
+              jnp.arange(k_pad, dtype=jnp.int32)[None, :]).astype(
+        jnp.float32)
+    return jax.lax.dot_general(
+        onehot, values, dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)[:total]
+
+
+def main():
+    from citus_tpu.ops.pallas_kernels import (
+        dense_grid_aggregate_pallas,
+        pallas_available,
+        segment_sum_reference,
+    )
+
+    print(f"backend: {jax.devices()[0].platform} "
+          f"({jax.devices()[0].device_kind}); "
+          f"pallas: {pallas_available()}")
+    rng = np.random.default_rng(0)
+    rows = []
+    for n, k in [(1 << 20, 16), (1 << 20, 512), (1 << 20, 4096),
+                 (1 << 23, 16), (1 << 23, 512), (1 << 23, 4096),
+                 (1 << 23, 8192)]:
+        slot = jnp.asarray(rng.integers(0, k, n).astype(np.int32))
+        vals = jnp.asarray(rng.uniform(0, 100, (n, 6)).astype(np.float32))
+
+        t_seg = timeit(lambda s, v, total=k: xla_segment_sum(s, v, total),
+                       slot, vals)
+        t_oh = timeit(lambda s, v, total=k: xla_onehot_matmul(s, v, total),
+                      slot, vals)
+        t_pl = None
+        ok = True
+        if pallas_available():
+            try:
+                f_pl = (lambda s, v, total=k:
+                        dense_grid_aggregate_pallas(s, v, total))
+                got = np.asarray(f_pl(slot, vals))
+                want = segment_sum_reference(np.asarray(slot),
+                                             np.asarray(vals), k)
+                ok = np.allclose(got, want, rtol=1e-3, atol=1.0)
+                t_pl = timeit(f_pl, slot, vals)
+            except Exception as e:
+                t_pl = None
+                print(f"  pallas failed at n={n} k={k}: "
+                      f"{str(e).splitlines()[0][:120]}")
+        rows.append((n, k, t_seg, t_oh, t_pl, ok))
+        print(f"n={n:>9} k={k:>5}  xla_segsum={t_seg * 1e3:8.2f}ms  "
+              f"xla_onehot={t_oh * 1e3:8.2f}ms  "
+              f"pallas={'n/a' if t_pl is None else f'{t_pl * 1e3:8.2f}ms'}"
+              f"  correct={ok}")
+
+    best_counts = {"segsum": 0, "onehot": 0, "pallas": 0}
+    for n, k, t_seg, t_oh, t_pl, ok in rows:
+        opts = {"segsum": t_seg, "onehot": t_oh}
+        if t_pl is not None and ok:
+            opts["pallas"] = t_pl
+        best_counts[min(opts, key=opts.get)] += 1
+    print("winner histogram:", best_counts)
+
+
+if __name__ == "__main__":
+    main()

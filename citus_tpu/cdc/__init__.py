@@ -1,0 +1,1 @@
+from .feed import ChangeLog  # noqa: F401

@@ -1,0 +1,164 @@
+"""Change data capture: a manifest-delta change feed.
+
+The reference implements CDC as a wrapper WAL decoder
+(/root/reference/src/backend/distributed/cdc/cdc_decoder.c): it maps
+shard-level WAL changes to the distributed table they belong to and drops
+changes produced by internal shard transfers (replication origin
+DoNotReplicateId, distributed/README.md:2702-2720).
+
+With immutable stripes the TPU-native equivalent is much simpler: every
+logical mutation is a manifest flip (stripe committed / deletion-bitmap
+advanced), so the change feed is an append-only journal written at the
+same commit points, with internal data movement (shard move / split /
+rebalance / cleanup) suppressed at the source — those rewrite placement,
+not table contents.
+
+Events (JSONL, one per line, monotonically increasing `lsn`):
+  {"lsn", "ts", "table", "kind": "insert", "shard_id", "file", "rows"}
+  {"lsn", "ts", "table", "kind": "delete", "shard_id", "file",
+   "count", "positions": [...]}     # physical row positions in the stripe
+
+Row payloads are late-materialized: `rows_for(event)` reads the referenced
+stripe (insert) or the pre-image positions (delete) on demand — the
+analogue of logical decoding reading row images out of the WAL.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+
+# deletes bigger than this store only the count (consumer re-reads the
+# current bitmap); keeps journal lines bounded
+MAX_INLINE_POSITIONS = 10_000
+
+
+class ChangeLog:
+    """Append-only change journal for one data directory."""
+
+    def __init__(self, data_dir: str, enabled: bool = True):
+        self.path = os.path.join(data_dir, "cdc_changes.jsonl")
+        self.enabled = enabled
+        self._mu = threading.Lock()
+        self._tls = threading.local()
+        self._next_lsn = self._scan_next_lsn()
+
+    def _scan_next_lsn(self) -> int:
+        """Max parseable lsn + 1.  A crash mid-append can tear the LAST
+        line; falling back to the highest intact lsn (never to 1 — that
+        would restart the sequence and strand every subscriber's
+        from_lsn cursor)."""
+        if not os.path.exists(self.path):
+            return 1
+        top = 0
+        with open(self.path, "rb") as f:
+            for line in f:
+                if not line.strip():
+                    continue
+                try:
+                    top = max(top, int(json.loads(line)["lsn"]))
+                except (ValueError, KeyError):
+                    continue  # torn tail line
+        return top + 1
+
+    # -- suppression (the DoNotReplicateId analogue) ---------------------
+    @contextlib.contextmanager
+    def suppress(self):
+        """Internal data movement (move/split/cleanup) must not surface
+        as logical changes.  Thread-local: background jobs suppress only
+        their own writes."""
+        prev = getattr(self._tls, "suppressed", False)
+        self._tls.suppressed = True
+        try:
+            yield
+        finally:
+            self._tls.suppressed = prev
+
+    @property
+    def suppressed(self) -> bool:
+        return getattr(self._tls, "suppressed", False)
+
+    # -- producer --------------------------------------------------------
+    @staticmethod
+    def insert_event(table: str, shard_id: int, record: dict) -> dict:
+        return {"table": table, "kind": "insert", "shard_id": shard_id,
+                "file": record["file"], "rows": record["rows"]}
+
+    @staticmethod
+    def delete_event(table: str, shard_id: int, fname: str,
+                     positions) -> dict:
+        import numpy as np
+
+        pos = np.flatnonzero(np.asarray(positions))
+        ev = {"table": table, "kind": "delete", "shard_id": shard_id,
+              "file": fname, "count": int(len(pos))}
+        if len(pos) <= MAX_INLINE_POSITIONS:
+            ev["positions"] = pos.tolist()
+        return ev
+
+    def emit(self, events: list[dict]) -> None:
+        """Append a commit's worth of events: one write + fsync."""
+        if not self.enabled or self.suppressed or not events:
+            return
+        with self._mu:
+            now = time.time()
+            payload = []
+            for ev in events:
+                ev["lsn"] = self._next_lsn
+                ev["ts"] = now
+                self._next_lsn += 1
+                payload.append(json.dumps(ev))
+            with open(self.path, "a") as f:
+                f.write("\n".join(payload) + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+
+    # -- consumer --------------------------------------------------------
+    def read(self, table: str | None = None, from_lsn: int = 0,
+             limit: int | None = None) -> list[dict]:
+        """Events with lsn > from_lsn, oldest first (the subscription
+        catch-up read; consumers poll with their last-seen lsn)."""
+        out: list[dict] = []
+        if not os.path.exists(self.path):
+            return out
+        with open(self.path) as f:
+            for line in f:
+                if not line.strip():
+                    continue
+                ev = json.loads(line)
+                if ev["lsn"] <= from_lsn:
+                    continue
+                if table is not None and ev["table"] != table:
+                    continue
+                out.append(ev)
+                if limit is not None and len(out) >= limit:
+                    break
+        return out
+
+    def last_lsn(self) -> int:
+        return self._next_lsn - 1
+
+
+def rows_for(store, event: dict):
+    """Materialize an event's row payload: (values, validity) dicts for
+    inserts; the deleted rows' pre-image for deletes (positions-backed
+    events only).  Late materialization keeps the journal small."""
+    table = event["table"]
+    shard_id = event["shard_id"]
+    vals, mask, _n, _dm = store.read_stripe_raw(table, shard_id,
+                                                event["file"])
+    if event["kind"] == "insert":
+        return vals, mask
+    positions = event.get("positions")
+    if positions is None:
+        raise ValueError(
+            "delete event has no inline positions (bulk delete); "
+            "re-read the stripe's current bitmap instead")
+    import numpy as np
+
+    idx = np.asarray(positions, dtype=np.int64)
+    return ({c: a[idx] for c, a in vals.items()},
+            {c: a[idx] for c, a in mask.items()})

@@ -1253,8 +1253,8 @@ class PlanCompiler:
         # value inputs (value, kind, contrib_valid) — counts in int32
         # (int64 segment ops are emulated on TPU), widened after reduce
         values = self._agg_values(node, blk)
-        rows_per_slot = jax.ops.segment_sum(
-            blk.valid.astype(jnp.int32), slot, num_segments=total + 1)[:total]
+        rows_per_slot = self._dense_segment_sum(
+            blk.valid.astype(jnp.int32)[:, None], slot, total)[:total, 0]
 
         # stacked reductions: one segment op per (reduction kind, dtype)
         results: list = [None] * len(values)
@@ -1284,8 +1284,7 @@ class PlanCompiler:
         for (op, _dt), items in by_kind.items():
             data = jnp.stack([a for _, a in items], axis=1)
             if op in ("sum", "companion"):
-                red = jax.ops.segment_sum(data, slot,
-                                          num_segments=total + 1)
+                red = self._dense_segment_sum(data, slot, total)
             elif op == "min":
                 red = jax.ops.segment_min(data, slot,
                                           num_segments=total + 1)
@@ -1337,6 +1336,36 @@ class PlanCompiler:
             if companions[i] is not None:
                 nulls[cid] = companions[i] == 0
         return Block(cols, out_valid, nulls)
+
+    # one-hot MXU segment-sum eligibility bound: bench_kernels.py on
+    # TPU v5e measured the matmul formulation 2-10× faster than XLA's
+    # scatter-based segment_sum up to ~4096 slots, slower past ~8192
+    # (a hand Pallas kernel of the same shape measured slower than both
+    # — the measured justification for staying at the XLA level)
+    DENSE_ONEHOT_MAX_SLOTS = 4096
+
+    def _dense_segment_sum(self, data: jnp.ndarray, slot: jnp.ndarray,
+                           total: int) -> jnp.ndarray:
+        """Σ per slot of [n, m] data → [total+1, m].
+
+        Routes to one-hot × data on the MXU when exactness allows:
+        f32 sums accumulate in f32 either way, and int32 counts are
+        exact in f32 while n < 2^24 (n is the static row capacity).
+        int64 / f64 stacks stay on segment_sum (exact)."""
+        n, _m = data.shape
+        dt = data.dtype
+        eligible = (total + 1 <= self.DENSE_ONEHOT_MAX_SLOTS
+                    and (dt == jnp.float32
+                         or (dt == jnp.int32 and n < (1 << 24))))
+        if not eligible:
+            return jax.ops.segment_sum(data, slot, num_segments=total + 1)
+        onehot = (slot[:, None] == jnp.arange(
+            total + 1, dtype=jnp.int32)[None, :]).astype(jnp.float32)
+        red = jax.lax.dot_general(
+            onehot, data.astype(jnp.float32),
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return red.astype(dt) if dt == jnp.int32 else red
 
     def _slice_groups(self, node: AggregateNode, gk, res, gvalid, ngroups):
         """Slice front-packed group slots down to the planner's estimated

@@ -198,6 +198,18 @@ class StreamBatcher:
         shards = catalog.table_shards(table)
         placement = table_placement(catalog, table, n_dev)
         self.colnames = [cid.split(".", 1)[1] for cid in node.columns]
+        # same storage-name-mapped chunk-group skip filter the resident
+        # feed path applies (min/max pruning must not vanish just
+        # because the table streams)
+        self._chunk_filter = None
+        if node.filter is not None:
+            from .feed import make_chunk_filter
+
+            meta0 = catalog.table(table)
+            name_map = {c.name: store.storage_column_name(table, c.name)
+                        for c in meta0.schema.columns}
+            self._chunk_filter = make_chunk_filter(node.filter, None,
+                                                   name_map)
         self._dev_shards: list[list[int]] = [[] for _ in range(n_dev)]
         self._dev_rows = [0] * n_dev
         for s, dev in zip(shards, placement):
@@ -235,7 +247,8 @@ class StreamBatcher:
     def _stripes(self, dev: int):
         for sid in self._dev_shards[dev]:
             yield from self.store.iter_shard_stripes(
-                self.node.rel.table, sid, self.colnames)
+                self.node.rel.table, sid, self.colnames,
+                self._chunk_filter)
 
     def _pull(self, dev: int, want: int):
         """Up to `want` rows from device dev's stripe stream."""

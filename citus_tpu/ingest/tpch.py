@@ -261,8 +261,11 @@ REFERENCE_TABLES = ["region", "nation"]
 
 
 def load_into_session(session, sf: float = 0.001, seed: int = 0,
-                      shard_count: int | None = None) -> dict[str, int]:
-    """Create, distribute and load all 8 tables; returns row counts."""
+                      shard_count: int | None = None,
+                      tables: set[str] | None = None) -> dict[str, int]:
+    """Create, distribute and load all 8 tables; returns row counts.
+    `tables` restricts which tables get DATA (schemas always exist) —
+    large-scale bench runs skip the tables their queries never touch."""
     from .copy_from import _ingest_batch
 
     data = generate_tables(sf, seed)
@@ -275,6 +278,9 @@ def load_into_session(session, sf: float = 0.001, seed: int = 0,
                                          colocate_with=colocate)
     for table in REFERENCE_TABLES:
         session.create_reference_table(table)
+    if tables is not None:
+        data = {t: cols for t, cols in data.items()
+                if t in tables or t in REFERENCE_TABLES}
     for table, cols in data.items():
         names = list(cols.keys())
         # numeric columns pass through as numpy (zero-copy ingest fast

@@ -40,11 +40,6 @@ def _build_and_load():
             check=True, capture_output=True, timeout=120)
         os.replace(tmp, so)
     lib = ctypes.CDLL(so)
-    lib.ct_intern_batch.restype = ctypes.c_int64
-    lib.ct_intern_batch.argtypes = [
-        ctypes.c_char_p, _I64P, _I64P, ctypes.c_int64,
-        ctypes.c_char_p, _I64P, _I64P, ctypes.c_int64,
-        _I32P, _I64P]
     lib.ct_string_hash_tokens.restype = None
     lib.ct_string_hash_tokens.argtypes = [
         ctypes.c_char_p, _I64P, _I64P, ctypes.c_int64, _I32P]
@@ -94,22 +89,6 @@ def pack_strings(values) -> tuple[bytes, np.ndarray, np.ndarray] | None:
     ends[:-1] = seps
     ends[-1] = len(buf)
     return buf, starts, ends
-
-
-def intern_batch(dict_pack, in_pack, dict_n: int):
-    """Native bulk intern. Returns (codes int32[n], new_indices int64[k])
-    where new_indices are input positions that created new entries, in
-    code order starting at dict_n."""
-    lib = get_lib()
-    assert lib is not None
-    dbuf, dstarts, dends = dict_pack
-    ibuf, istarts, iends = in_pack
-    n = len(istarts)
-    codes = np.empty(n, np.int32)
-    new_idx = np.empty(max(n, 1), np.int64)
-    k = lib.ct_intern_batch(dbuf, dstarts, dends, dict_n,
-                            ibuf, istarts, iends, n, codes, new_idx)
-    return codes, new_idx[:k]
 
 
 class DictHandle:

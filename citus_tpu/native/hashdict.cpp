@@ -138,35 +138,6 @@ struct CtDict {
 
 extern "C" {
 
-// Bulk dictionary intern: existing entries (dict_*) + a batch of input
-// strings (in_*) -> int32 code per input (existing entries keep their
-// index; new entries get dict_n + first-occurrence order).  Indices of
-// inputs that created new entries are written to new_indices (capacity
-// in_n).  Returns the number of new entries.
-int64_t ct_intern_batch(const char* dict_buf, const int64_t* dict_starts,
-                        const int64_t* dict_ends, int64_t dict_n,
-                        const char* in_buf, const int64_t* in_starts,
-                        const int64_t* in_ends, int64_t in_n,
-                        int32_t* out_codes, int64_t* new_indices) {
-    InternTable table(static_cast<size_t>(dict_n + in_n));
-    bool inserted = false;
-    for (int64_t i = 0; i < dict_n; ++i) {
-        table.upsert(dict_buf + dict_starts[i],
-                     static_cast<int32_t>(dict_ends[i] - dict_starts[i]),
-                     static_cast<int32_t>(i), &inserted);
-    }
-    int64_t n_new = 0;
-    for (int64_t i = 0; i < in_n; ++i) {
-        int32_t code = table.upsert(
-            in_buf + in_starts[i],
-            static_cast<int32_t>(in_ends[i] - in_starts[i]),
-            static_cast<int32_t>(dict_n + n_new), &inserted);
-        if (inserted) new_indices[n_new++] = i;
-        out_codes[i] = code;
-    }
-    return n_new;
-}
-
 // -- persistent dictionary handle -------------------------------------
 
 void* ct_dict_new() { return new CtDict(); }

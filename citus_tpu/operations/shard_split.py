@@ -188,14 +188,18 @@ def _rewrite_shard(session, table: str, parent: ShardInterval,
     codec = session.settings.get("columnar_compression")
     level = session.settings.get("columnar_compression_level")
     chunk_rows = session.settings.get("columnar_chunk_group_row_limit")
-    for i, cid in enumerate(child_ids):
-        mask = child_idx == i
-        if not mask.any():
-            continue
-        sub = {c: vals[c][mask] for c in vals}
-        subv = {c: valid[c][mask] for c in valid}
-        store.append_stripe(table, cid, sub, subv, codec=codec,
-                            level=level, chunk_rows=chunk_rows)
+    # physical re-placement, not a logical change: the change feed must
+    # not see split rewrites (the DoNotReplicateId analogue,
+    # cdc/cdc_decoder.c drop of internal-transfer changes)
+    with store.change_log.suppress():
+        for i, cid in enumerate(child_ids):
+            mask = child_idx == i
+            if not mask.any():
+                continue
+            sub = {c: vals[c][mask] for c in vals}
+            subv = {c: valid[c][mask] for c in valid}
+            store.append_stripe(table, cid, sub, subv, codec=codec,
+                                level=level, chunk_rows=chunk_rows)
 
 
 def isolate_tenant_to_node(session, table: str, tenant_value) -> int:

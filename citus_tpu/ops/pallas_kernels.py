@@ -1,0 +1,126 @@
+"""Pallas TPU kernels for the aggregation hot path.
+
+BASELINE.json's north star calls for hand kernels on the hot ops (the
+reference's equivalents are C inner loops: per-tuple hash-aggregate
+transition functions reached from the plans in
+planner/multi_logical_optimizer.c).  The XLA formulation used by
+ops/aggregate.py covers most shapes well; the one place XLA lowers badly
+on TPU is `jax.ops.segment_sum` with mid-sized segment counts — it emits
+a serialized scatter-add.  This kernel replaces it with the MXU-friendly
+formulation: one-hot × values matmuls accumulated in VMEM scratch across
+a sequential row-tile grid.
+
+    sums[k, a] = Σ_{i: slot[i]=k} values[i, a]
+
+The grid walks row tiles; a [K, A] f32 scratch lives in VMEM for the
+whole pass (TPU grid steps run sequentially on one core, so scratch
+accumulation is safe); each step builds an f32 one-hot tile chunked over
+K and feeds the MXU with f32 accumulation (one-hot entries are exact in
+any float dtype; values stay f32 so sums match the XLA path).
+
+Whether this beats the XLA segment ops on real hardware is measured by
+bench_kernels.py; the executor only routes through it when
+`enable_pallas_aggregate` is on and the measurement said yes.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+try:  # Pallas TPU lowering may be unavailable on exotic backends
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    _PALLAS_OK = True
+except Exception:  # pragma: no cover
+    _PALLAS_OK = False
+
+ROW_TILE = 1024       # rows per grid step
+K_CHUNK = 512         # one-hot width per MXU feed
+
+
+def _round_up(n: int, m: int) -> int:
+    return -(-n // m) * m
+
+
+def pallas_available() -> bool:
+    return _PALLAS_OK
+
+
+if _PALLAS_OK:
+
+    def _kernel(slot_ref, val_ref, out_ref, acc_ref, *, n_chunks: int):
+        """One grid step: accumulate this row tile into [K, A] scratch."""
+        step = pl.program_id(0)
+
+        @pl.when(step == 0)
+        def _init():
+            acc_ref[:] = jnp.zeros_like(acc_ref)
+
+        slots = slot_ref[:]                       # [T, 1] int32
+        vals = val_ref[:]                         # [T, A] f32
+        for c in range(n_chunks):
+            base = c * K_CHUNK
+            ids = jax.lax.broadcasted_iota(
+                jnp.int32, (ROW_TILE, K_CHUNK), 1) + base
+            onehot = (slots == ids).astype(jnp.float32)   # [T,1]→[T,Kc]
+            part = jax.lax.dot_general(
+                onehot, vals,
+                dimension_numbers=(((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)        # [Kc, A]
+            sl = pl.ds(base, K_CHUNK)
+            acc_ref[sl, :] = acc_ref[sl, :] + part
+
+        @pl.when(step == pl.num_programs(0) - 1)
+        def _flush():
+            out_ref[:] = acc_ref[:]
+
+    @functools.partial(jax.jit, static_argnames=("total", "interpret"))
+    def dense_grid_aggregate_pallas(slot: jnp.ndarray,
+                                    values: jnp.ndarray, total: int,
+                                    interpret: bool = False
+                                    ) -> jnp.ndarray:
+        """MXU segment-sum: slot [N] int32 (== total ⇒ ignored row),
+        values [N, A] float32 → sums [total, A] float32."""
+        n = slot.shape[0]
+        a = values.shape[1]
+        n_pad = _round_up(max(n, ROW_TILE), ROW_TILE)
+        k_pad = _round_up(total + 1, K_CHUNK)  # +1 keeps a trash slot
+        a_pad = _round_up(a, 128)
+        grid = n_pad // ROW_TILE
+        # slots as [N, 1]: a block whose LAST dim equals the whole array
+        # dim satisfies the TPU tiling rule, and [T, 1] == [T, Kc]
+        # broadcasts without any in-kernel reshape (Mosaic rejects
+        # (8,128)→(1024,1) shape casts)
+        slot_p = jnp.full((n_pad, 1), k_pad - 1, jnp.int32).at[:n, 0].set(
+            jnp.where(slot >= total, k_pad - 1, slot))
+        vals_p = jnp.zeros((n_pad, a_pad), jnp.float32) \
+            .at[:n, :a].set(values.astype(jnp.float32))
+
+        kernel = functools.partial(_kernel, n_chunks=k_pad // K_CHUNK)
+        out = pl.pallas_call(
+            kernel,
+            grid=(grid,),
+            in_specs=[
+                pl.BlockSpec((ROW_TILE, 1), lambda i: (i, 0)),
+                pl.BlockSpec((ROW_TILE, a_pad), lambda i: (i, 0)),
+            ],
+            out_specs=pl.BlockSpec((k_pad, a_pad), lambda i: (0, 0)),
+            out_shape=jax.ShapeDtypeStruct((k_pad, a_pad), jnp.float32),
+            scratch_shapes=[pltpu.VMEM((k_pad, a_pad), jnp.float32)],
+            interpret=interpret,
+        )(slot_p, vals_p)
+        return out[:total, :a]
+
+
+def segment_sum_reference(slot: np.ndarray, values: np.ndarray,
+                          total: int) -> np.ndarray:
+    """numpy oracle for tests."""
+    out = np.zeros((total, values.shape[1]), np.float32)
+    keep = slot < total
+    np.add.at(out, slot[keep], values[keep].astype(np.float32))
+    return out

@@ -36,15 +36,24 @@ def ensure_jax_configured(platform: str | None = None,
         jax.config.update("jax_platforms", platform)
     if not _configured:
         # persistent XLA executable cache: repeated plan shapes skip the
-        # (tens of seconds, on remote TPUs) cold compile across processes
+        # (tens of seconds, on remote TPUs) cold compile across processes.
+        # CPU-backend processes skip it: XLA's CPU executable.serialize()
+        # segfaults after a few hundred distinct compilations in one
+        # process (observed killing 500-query fuzz runs), and the
+        # in-process plan cache covers repeats there anyway.
+        plat = (platform or str(getattr(jax.config, "jax_platforms", "")
+                                or os.environ.get("JAX_PLATFORMS") or ""))
         cache_dir = os.environ.get(
             "CITUS_TPU_COMPILE_CACHE",
             os.path.join(os.path.expanduser("~"), ".cache",
                          "citus_tpu_xla"))
         try:
-            jax.config.update("jax_compilation_cache_dir", cache_dir)
-            jax.config.update("jax_persistent_cache_min_compile_time_secs",
-                              1.0)
+            if "cpu" in plat:
+                jax.config.update("jax_enable_compilation_cache", False)
+            else:
+                jax.config.update("jax_compilation_cache_dir", cache_dir)
+                jax.config.update(
+                    "jax_persistent_cache_min_compile_time_secs", 1.0)
         except Exception:
             pass  # older jax without persistent-cache config
     _configured = True

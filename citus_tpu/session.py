@@ -52,7 +52,8 @@ _UDFS = ("create_distributed_table", "create_reference_table",
          "citus_split_shard_by_split_points", "isolate_tenant_to_node",
          "citus_cleanup_orphaned_resources",
          "citus_rebalance_start", "citus_rebalance_wait",
-         "citus_job_wait", "citus_job_cancel", "citus_job_list")
+         "citus_job_wait", "citus_job_cancel", "citus_job_list",
+         "citus_change_feed", "citus_create_restore_point")
 
 
 class _StoreStats(StatsProvider):
@@ -226,6 +227,19 @@ class Session:
         self.jobs.shutdown()
         self._save_catalog()
 
+    # -- change data capture ----------------------------------------------
+    def change_events(self, table: str | None = None,
+                      from_lsn: int = 0) -> list[dict]:
+        """Committed logical changes with lsn > from_lsn (the change-feed
+        subscription read; ref: cdc/cdc_decoder.c)."""
+        return self.store.change_log.read(table, from_lsn)
+
+    def change_rows(self, event: dict):
+        """Materialize one event's row payload: (values, validity)."""
+        from .cdc.feed import rows_for
+
+        return rows_for(self.store, event)
+
     # -- statement dispatch ------------------------------------------------
     def _execute_statement(self, stmt: ast.Statement):
         if isinstance(stmt, ast.Select):
@@ -362,6 +376,23 @@ class Session:
             from .transaction.clock import global_clock
 
             return ResultSet(["clock"], {"clock": [global_clock.now()]}, 1)
+        elif e.name == "citus_change_feed":
+            table = str(args[0]) if args else None
+            from_lsn = int(args[1]) if len(args) > 1 else 0
+            events = self.change_events(table, from_lsn)
+            return ResultSet(
+                ["lsn", "kind", "shard_id", "file", "rows"],
+                {"lsn": [ev["lsn"] for ev in events],
+                 "kind": [ev["kind"] for ev in events],
+                 "shard_id": [ev["shard_id"] for ev in events],
+                 "file": [ev["file"] for ev in events],
+                 "rows": [ev.get("rows", ev.get("count", 0))
+                          for ev in events]}, len(events))
+        elif e.name == "citus_create_restore_point":
+            from .operations.restore_point import create_restore_point
+
+            name = create_restore_point(self, str(args[0]))
+            return ResultSet(["restore_point"], {"restore_point": [name]}, 1)
         elif e.name == "citus_stat_counters":
             snap = self.stats.counters.snapshot()
             names = sorted(snap)

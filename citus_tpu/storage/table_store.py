@@ -80,6 +80,12 @@ class TableStore:
         # records and deletion masks folded into every read
         self.overlay = None
         os.makedirs(os.path.join(data_dir, "tables"), exist_ok=True)
+        # change feed journal (cdc_decoder.c analogue): written at the
+        # same manifest-flip points that make changes visible; internal
+        # shard movement suppresses itself via change_log.suppress()
+        from ..cdc import ChangeLog
+
+        self.change_log = ChangeLog(data_dir)
 
     # -- paths -------------------------------------------------------------
     def table_dir(self, table: str) -> str:
@@ -268,6 +274,11 @@ class TableStore:
                 man["next_stripe"] = max(man["next_stripe"], stripe_no + 1)
             self._save_manifest(table)
             self.bump_data_version(table)
+            # change feed AFTER the durable flip: a crash in between
+            # loses the event (at-most-once) but never emits a phantom
+            self.change_log.emit([
+                self.change_log.insert_event(table, sid, rec)
+                for sid, rec in pending])
 
     # -- DML (deletion bitmaps) -------------------------------------------
     # The reference's columnar engine is append-only (columnar/README.md:
@@ -319,6 +330,7 @@ class TableStore:
         from ..utils.faultinjection import fault_point
 
         fault_point("store.apply_dml")
+        events: list[dict] = []
         with self._write_lock(table), self._lock:
             self.save_dictionaries(table)
             man = self._reload_manifest_locked(table)
@@ -332,6 +344,8 @@ class TableStore:
                 recs.append(record)
                 stripe_no = int(record["file"].split("_")[1].split(".")[0])
                 man["next_stripe"] = max(man["next_stripe"], stripe_no + 1)
+                events.append(self.change_log.insert_event(
+                    table, shard_id, record))
             for shard_id, per_stripe in deletes.items():
                 records = man["shards"].get(str(shard_id), [])
                 by_file = {r["file"]: r for r in records}
@@ -344,6 +358,10 @@ class TableStore:
                             f"{table}/{fname}: delete mask length "
                             f"{len(mask)} != stripe rows {rec['rows']}")
                     old = self.load_delete_mask(table, shard_id, rec)
+                    newly = mask if old is None else (mask & ~old)
+                    if newly.any():
+                        events.append(self.change_log.delete_event(
+                            table, shard_id, fname, newly))
                     combined = mask if old is None else (old | mask)
                     version = rec.get("del_version", 0) + 1
                     delname = f"{fname}.del{version:04d}.npy"
@@ -362,6 +380,7 @@ class TableStore:
                     rec["live_rows"] = int((~combined).sum())
             self._save_manifest(table)
             self.bump_data_version(table)
+            self.change_log.emit(events)
             for path in stale:
                 try:
                     os.unlink(path)

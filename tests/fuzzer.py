@@ -1,0 +1,266 @@
+"""Randomized query generator + shrinker over the TPC-H schema.
+
+The framework's analogue of the reference's query_generator
+(/root/reference/src/test/regress/citus_tests/query_generator/): generate
+random join/filter/aggregate queries, run them through the distributed
+engine AND a sqlite oracle holding the same rows, and compare.  On a
+mismatch, greedily shrink the structured query (drop joins, filters,
+select items) to the smallest still-failing SQL before reporting.
+
+Queries are built from a structured form (not strings) so shrinking is a
+matter of removing parts and re-rendering.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field, replace
+
+# column catalog: (name, kind) where kind ∈ int | float | date | str
+TABLES: dict[str, list[tuple[str, str]]] = {
+    "lineitem": [
+        ("l_orderkey", "int"), ("l_partkey", "int"), ("l_suppkey", "int"),
+        ("l_linenumber", "int"), ("l_quantity", "float"),
+        ("l_extendedprice", "float"), ("l_discount", "float"),
+        ("l_shipdate", "date"), ("l_returnflag", "str"),
+        ("l_shipmode", "str"),
+    ],
+    "orders": [
+        ("o_orderkey", "int"), ("o_custkey", "int"),
+        ("o_totalprice", "float"), ("o_orderdate", "date"),
+        ("o_orderstatus", "str"), ("o_shippriority", "int"),
+    ],
+    "customer": [
+        ("c_custkey", "int"), ("c_nationkey", "int"),
+        ("c_acctbal", "float"), ("c_mktsegment", "str"),
+    ],
+    "supplier": [
+        ("s_suppkey", "int"), ("s_nationkey", "int"),
+        ("s_acctbal", "float"),
+    ],
+    "nation": [
+        ("n_nationkey", "int"), ("n_regionkey", "int"), ("n_name", "str"),
+    ],
+    "part": [
+        ("p_partkey", "int"), ("p_size", "int"),
+        ("p_retailprice", "float"), ("p_brand", "str"),
+    ],
+}
+
+# join graph: (left table, left col, right table, right col, kind)
+# kind "fk" = equi-join along a real relationship; "cross" = unrelated
+# equi keys (exercises dual-repartition strategies)
+EDGES = [
+    ("lineitem", "l_orderkey", "orders", "o_orderkey", "fk"),
+    ("orders", "o_custkey", "customer", "c_custkey", "fk"),
+    ("lineitem", "l_suppkey", "supplier", "s_suppkey", "fk"),
+    ("lineitem", "l_partkey", "part", "p_partkey", "fk"),
+    ("customer", "c_nationkey", "nation", "n_nationkey", "fk"),
+    ("supplier", "s_nationkey", "nation", "n_nationkey", "fk"),
+    ("orders", "o_custkey", "lineitem", "l_suppkey", "cross"),
+    ("customer", "c_nationkey", "part", "p_size", "cross"),
+]
+
+STR_POOLS = {
+    "l_returnflag": ["A", "N", "R"],
+    "l_shipmode": ["AIR", "RAIL", "SHIP", "TRUCK", "MAIL"],
+    "o_orderstatus": ["F", "O", "P"],
+    "c_mktsegment": ["AUTOMOBILE", "BUILDING", "FURNITURE", "MACHINERY"],
+    "n_name": ["FRANCE", "GERMANY", "CHINA", "KENYA", "PERU"],
+    "p_brand": ["Brand#11", "Brand#22", "Brand#33"],
+}
+
+DATE_POOL = ["1993-06-30", "1994-12-01", "1996-03-15", "1997-09-01"]
+INT_POOL = [1, 3, 10, 40, 100, 900, 4000]
+FLOAT_POOL = [0.02, 0.05, 25.0, 900.0, 4500.0, 100000.0]
+
+AGG_FUNCS = ["count_star", "count", "sum", "min", "max", "avg",
+             "count_distinct"]
+
+
+@dataclass
+class Fuzz:
+    tables: list[str]
+    joins: list[tuple]            # (ltab, lcol, rtab, rcol, jointype)
+    filters: list[str] = field(default_factory=list)
+    group_by: list[str] = field(default_factory=list)
+    aggs: list[str] = field(default_factory=list)    # rendered agg exprs
+    plain_select: list[str] = field(default_factory=list)
+    having: str | None = None
+    order_limit: str | None = None
+
+    def sql(self) -> str:
+        frm = self.tables[0]
+        for ltab, lcol, rtab, rcol, jt in self.joins:
+            frm += (f" {jt} join {rtab} on {lcol} = {rcol}"
+                    if jt != "inner"
+                    else f" join {rtab} on {lcol} = {rcol}")
+        if self.group_by or self.aggs:
+            items = self.group_by + self.aggs
+        else:
+            items = self.plain_select
+        q = f"select {', '.join(items)} from {frm}"
+        if self.filters:
+            q += " where " + " and ".join(self.filters)
+        if self.group_by:
+            q += " group by " + ", ".join(self.group_by)
+        if self.having:
+            q += " having " + self.having
+        if self.order_limit:
+            q += " " + self.order_limit
+        return q
+
+
+def _columns_of(tables) -> list[tuple[str, str]]:
+    out = []
+    for t in tables:
+        out.extend(TABLES[t])
+    return out
+
+
+def _rand_filter(rng: random.Random, tables) -> str | None:
+    cols = _columns_of(tables)
+    name, kind = rng.choice(cols)
+    if kind == "str":
+        pool = STR_POOLS[name]
+        if rng.random() < 0.3:
+            vals = rng.sample(pool, k=min(2, len(pool)))
+            return f"{name} in ({', '.join(repr(v) for v in vals)})"
+        return f"{name} = {rng.choice(pool)!r}"
+    op = rng.choice(["<", "<=", ">", ">=", "="])
+    if kind == "date":
+        return f"{name} {op} date '{rng.choice(DATE_POOL)}'"
+    if kind == "int":
+        return f"{name} {op} {rng.choice(INT_POOL)}"
+    return f"{name} {op} {rng.choice(FLOAT_POOL)}"
+
+
+def generate(rng: random.Random) -> Fuzz:
+    start = rng.choice(list(TABLES))
+    tables = [start]
+    joins = []
+    n_joins = rng.choice([0, 1, 1, 2, 2, 3])
+    while len(joins) < n_joins:
+        options = [e for e in EDGES
+                   if (e[0] in tables) != (e[2] in tables)]
+        if not options:
+            break
+        # cross (non-FK) edges are rarer — they explode row counts
+        weights = [1 if e[4] == "cross" else 4 for e in options]
+        ltab, lcol, rtab, rcol, kind = rng.choices(options,
+                                                   weights=weights)[0]
+        if rtab in tables:  # orient so the NEW table is on the right
+            ltab, lcol, rtab, rcol = rtab, rcol, ltab, lcol
+        jointype = "inner"
+        if kind == "fk" and rng.random() < 0.2:
+            jointype = "left"
+        joins.append((ltab, lcol, rtab, rcol, jointype))
+        tables.append(rtab)
+
+    f = Fuzz(tables=tables, joins=joins)
+    for _ in range(rng.choice([0, 1, 1, 2])):
+        flt = _rand_filter(rng, tables)
+        if flt:
+            f.filters.append(flt)
+
+    cols = _columns_of(tables)
+    if rng.random() < 0.65:  # aggregate mode
+        n_groups = rng.choice([0, 1, 1, 2])
+        group_pool = [c for c, k in cols if k in ("int", "str")]
+        rng.shuffle(group_pool)
+        f.group_by = group_pool[:n_groups]
+        for _ in range(rng.choice([1, 1, 2])):
+            fn = rng.choice(AGG_FUNCS)
+            if fn == "count_star":
+                f.aggs.append("count(*)")
+            else:
+                name, kind = rng.choice(
+                    [(c, k) for c, k in cols if k in ("int", "float")])
+                if fn == "count_distinct":
+                    f.aggs.append(f"count(distinct {name})")
+                elif fn == "count":
+                    f.aggs.append(f"count({name})")
+                else:
+                    f.aggs.append(f"{fn}({name})")
+        if not f.aggs:
+            f.aggs.append("count(*)")
+        if f.group_by and rng.random() < 0.25:
+            f.having = f"count(*) > {rng.choice([1, 3, 10])}"
+    else:  # plain projection mode
+        rng.shuffle(cols)
+        f.plain_select = [c for c, _ in cols[:rng.choice([1, 2, 3])]]
+        # deterministic ORDER BY + LIMIT only when a unique key of every
+        # joined table is part of the sort (total order ⇒ both engines
+        # agree on which rows survive the LIMIT)
+        if rng.random() < 0.4 and not any(
+                jt == "left" for *_x, jt in f.joins):
+            uniq = {"lineitem": ["l_orderkey", "l_linenumber"],
+                    "orders": ["o_orderkey"], "customer": ["c_custkey"],
+                    "supplier": ["s_suppkey"], "nation": ["n_nationkey"],
+                    "part": ["p_partkey"]}
+            keys = []
+            for t in f.tables:
+                keys.extend(uniq[t])
+            f.plain_select = sorted(set(f.plain_select) | set(keys))
+            f.order_limit = ("order by " + ", ".join(keys)
+                             + f" limit {rng.choice([5, 20, 100])}")
+    return f
+
+
+# ---------------------------------------------------------------------------
+
+
+def shrink(q: Fuzz, still_fails) -> Fuzz:
+    """Greedy structural shrink: try dropping parts; keep any variant
+    that still fails.  `still_fails(Fuzz) -> bool`."""
+    changed = True
+    budget = 60
+    while changed and budget > 0:
+        changed = False
+        candidates: list[Fuzz] = []
+        if q.having:
+            candidates.append(replace(q, having=None))
+        if q.order_limit:
+            candidates.append(replace(q, order_limit=None))
+        for i in range(len(q.filters)):
+            candidates.append(replace(
+                q, filters=q.filters[:i] + q.filters[i + 1:]))
+        if q.joins:
+            dropped = q.joins[-1]
+            keep_tabs = [t for t in q.tables if t != dropped[2]]
+            cols_left = {c for c, _ in _columns_of(keep_tabs)}
+
+            def refs_ok(expr: str) -> bool:
+                return not any(c in expr for c, _ in TABLES[dropped[2]])
+
+            candidates.append(Fuzz(
+                tables=keep_tabs, joins=q.joins[:-1],
+                filters=[flt for flt in q.filters if refs_ok(flt)],
+                group_by=[g for g in q.group_by if g in cols_left],
+                aggs=([a for a in q.aggs if refs_ok(a)] or ["count(*)"])
+                if q.aggs else [],
+                plain_select=[c for c in q.plain_select
+                              if c in cols_left] or
+                (list(cols_left)[:1] if not q.aggs else []),
+                having=q.having if q.having and refs_ok(q.having) else None,
+                order_limit=None if q.order_limit else None))
+        if len(q.aggs) > 1:
+            for i in range(len(q.aggs)):
+                candidates.append(replace(
+                    q, aggs=q.aggs[:i] + q.aggs[i + 1:]))
+        if len(q.group_by) > 1:
+            for i in range(len(q.group_by)):
+                candidates.append(replace(
+                    q, group_by=q.group_by[:i] + q.group_by[i + 1:]))
+        for cand in candidates:
+            budget -= 1
+            if budget <= 0:
+                break
+            try:
+                if still_fails(cand):
+                    q = cand
+                    changed = True
+                    break
+            except Exception:
+                continue  # shrink candidate itself invalid — skip
+    return q

@@ -1,0 +1,153 @@
+"""CDC change feed + cluster restore points (VERDICT missing #6 and #8).
+
+Reference behaviors mirrored:
+* cdc/cdc_decoder.c — shard-level changes surface as table-level events;
+  internal shard movement (move/split/rebalance) is invisible to the feed
+  (the DoNotReplicateId replication-origin drop).
+* operations/citus_create_restore_point.c — one consistent named snapshot
+  of the whole cluster, restorable.
+"""
+
+import numpy as np
+import pytest
+
+import citus_tpu
+from citus_tpu.errors import CatalogError
+from citus_tpu.operations.restore_point import (
+    list_restore_points,
+    restore_cluster,
+)
+
+
+@pytest.fixture()
+def sess(tmp_path):
+    s = citus_tpu.connect(data_dir=str(tmp_path / "d"), n_devices=4,
+                          compute_dtype="float64")
+    s.execute("create table ev (k bigint, v bigint, name text)")
+    s.create_distributed_table("ev", "k", shard_count=4)
+    yield s
+    s.close()
+
+
+class TestChangeFeed:
+    def test_insert_delete_update_events(self, sess):
+        sess.execute("insert into ev values (1, 10, 'a'), (2, 20, 'b'), "
+                     "(3, 30, 'c'), (4, 40, 'd')")
+        events = sess.change_events("ev")
+        assert events and all(e["kind"] == "insert" for e in events)
+        assert sum(e["rows"] for e in events) == 4
+        lsn0 = events[-1]["lsn"]
+
+        sess.execute("delete from ev where v >= 30")
+        dels = [e for e in sess.change_events("ev", from_lsn=lsn0)
+                if e["kind"] == "delete"]
+        assert sum(e["count"] for e in dels) == 2
+        # pre-image materialization: the deleted rows' values
+        deleted_vs = []
+        for e in dels:
+            vals, _mask = sess.change_rows(e)
+            deleted_vs.extend(np.asarray(vals["v"]).tolist())
+        assert sorted(deleted_vs) == [30, 40]
+
+        lsn1 = sess.store.change_log.last_lsn()
+        sess.execute("update ev set v = v + 1 where k = 1")
+        ups = sess.change_events("ev", from_lsn=lsn1)
+        kinds = sorted(e["kind"] for e in ups)
+        assert kinds == ["delete", "insert"]  # UPDATE = delete + append
+
+    def test_transaction_commits_emit_aborts_dont(self, sess):
+        lsn0 = sess.store.change_log.last_lsn()
+        sess.execute("begin")
+        sess.execute("insert into ev values (7, 70, 'x')")
+        sess.execute("rollback")
+        assert sess.change_events("ev", from_lsn=lsn0) == []
+        sess.execute("begin")
+        sess.execute("insert into ev values (8, 80, 'y')")
+        sess.execute("commit")
+        evs = sess.change_events("ev", from_lsn=lsn0)
+        assert [e["kind"] for e in evs] == ["insert"]
+
+    def test_internal_movement_invisible(self, sess):
+        sess.execute("insert into ev values " + ",".join(
+            f"({i}, {i * 10}, 'n{i}')" for i in range(40)))
+        lsn0 = sess.store.change_log.last_lsn()
+        shard = sess.catalog.table_shards("ev")[0]
+        mid = (shard.min_value + shard.max_value) // 2
+        sess.execute(f"select citus_split_shard_by_split_points("
+                     f"{shard.shard_id}, '{mid}')")
+        assert sess.change_events("ev", from_lsn=lsn0) == [], \
+            "split rewrites must not surface as logical changes"
+        # rows still all there, and NEW changes still flow
+        assert sess.execute("select count(*) from ev").rows()[0][0] == 40
+        sess.execute("insert into ev values (100, 1000, 'post')")
+        assert [e["kind"] for e in
+                sess.change_events("ev", from_lsn=lsn0)] == ["insert"]
+
+    def test_feed_via_sql_udf_and_persistence(self, sess, tmp_path):
+        sess.execute("insert into ev values (1, 10, 'a')")
+        r = sess.execute("select citus_change_feed('ev', 0)")
+        assert r.row_count >= 1
+        assert r.columns["kind"][0] == "insert"
+        # journal survives restart; lsn continues, not restarts
+        last = sess.store.change_log.last_lsn()
+        sess.close()
+        s2 = citus_tpu.connect(data_dir=str(tmp_path / "d"), n_devices=4,
+                               compute_dtype="float64")
+        s2.execute("insert into ev values (2, 20, 'b')")
+        evs = s2.change_events("ev")
+        assert evs[-1]["lsn"] == last + 1
+
+
+class TestRestorePoint:
+    def test_create_restore_roundtrip(self, sess, tmp_path):
+        sess.execute("insert into ev values (1, 10, 'a'), (2, 20, 'b')")
+        r = sess.execute("select citus_create_restore_point('rp1')")
+        assert r.columns["restore_point"][0] == "rp1"
+        assert list_restore_points(sess.data_dir) == ["rp1"]
+
+        # diverge: more DML + a DDL + a second table
+        sess.execute("insert into ev values (3, 30, 'c')")
+        sess.execute("delete from ev where k = 1")
+        sess.execute("alter table ev add column extra bigint")
+        sess.execute("create table other (x bigint)")
+        sess.create_distributed_table("other", "x", shard_count=2)
+        sess.execute("insert into other values (1)")
+        assert sess.execute("select count(*) from ev").rows()[0][0] == 2
+
+        data_dir = sess.data_dir
+        sess.close()
+        restore_cluster(data_dir, "rp1")
+        s2 = citus_tpu.connect(data_dir=data_dir, n_devices=4,
+                               compute_dtype="float64")
+        try:
+            rows = sorted(s2.execute(
+                "select k, v, name from ev").rows())
+            assert rows == [(1, 10, "a"), (2, 20, "b")]
+            assert not s2.catalog.has_table("other")
+            with pytest.raises(Exception):
+                s2.execute("select extra from ev")
+        finally:
+            s2.close()
+
+    def test_restore_point_survives_cleanup_of_originals(self, sess):
+        """Hardlinked stripes stay readable after the original file is
+        unlinked (deferred cleanup / DROP of the live table)."""
+        sess.execute("insert into ev values (1, 10, 'a')")
+        sess.execute("select citus_create_restore_point('rp2')")
+        data_dir = sess.data_dir
+        sess.execute("drop table ev")
+        sess.close()
+        restore_cluster(data_dir, "rp2")
+        s2 = citus_tpu.connect(data_dir=data_dir, n_devices=4,
+                               compute_dtype="float64")
+        try:
+            assert s2.execute("select count(*) from ev").rows()[0][0] == 1
+        finally:
+            s2.close()
+
+    def test_name_validation_and_duplicates(self, sess):
+        with pytest.raises(CatalogError):
+            sess.execute("select citus_create_restore_point('../evil')")
+        sess.execute("select citus_create_restore_point('dup')")
+        with pytest.raises(CatalogError):
+            sess.execute("select citus_create_restore_point('dup')")

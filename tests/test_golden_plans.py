@@ -1,0 +1,81 @@
+"""Golden-plan tests: EXPLAIN output pinned to checked-in snapshots.
+
+The analogue of the reference's expected-output regress files
+(src/test/regress/expected/*.out compared via normalizing diff): a plan
+change — strategy flip, lost pushdown, missing prune — shows up as a
+snapshot diff instead of a silent perf regression.
+
+Regenerate intentionally with:  GOLDEN_UPDATE=1 pytest tests/test_golden_plans.py
+"""
+
+import os
+
+import pytest
+
+import citus_tpu
+from citus_tpu.ingest import tpch
+
+GOLDEN_DIR = os.path.join(os.path.dirname(__file__), "golden")
+
+PLANS = {
+    "q1_scan_agg": tpch.Q1,
+    "q3_multi_join": tpch.Q3,
+    "q5_five_way_join": tpch.Q5,
+    "q6_selective_scan": tpch.Q6,
+    "q9_nine_way": tpch.Q9,
+    "fast_path_point_lookup":
+        "select o_totalprice from orders where o_orderkey = 7",
+    "broadcast_reference_join":
+        "select n_name, count(*) from supplier, nation "
+        "where s_nationkey = n_nationkey group by n_name",
+    "single_repartition":
+        "select count(*) from customer, orders where c_custkey = o_custkey",
+    "dual_repartition":
+        "select count(*) from orders, lineitem where o_custkey = l_suppkey",
+    "colocated_join_topk":
+        "select o_orderkey, l_extendedprice from orders, lineitem "
+        "where o_orderkey = l_orderkey "
+        "order by l_extendedprice desc limit 10",
+    "distinct_aggregate":
+        "select count(distinct l_suppkey) from lineitem",
+    "window_partition":
+        "select l_orderkey, sum(l_quantity) over "
+        "(partition by l_suppkey order by l_orderkey) from lineitem",
+    "left_outer_join":
+        "select count(*) from orders left join lineitem "
+        "on o_orderkey = l_orderkey and l_quantity > 45",
+    "grouped_having_order":
+        "select l_suppkey, sum(l_quantity) as q from lineitem "
+        "group by l_suppkey having sum(l_quantity) > 100 "
+        "order by q desc limit 5",
+}
+
+
+@pytest.fixture(scope="module")
+def sess(tmp_path_factory):
+    s = citus_tpu.connect(
+        data_dir=str(tmp_path_factory.mktemp("golden_tpch")),
+        n_devices=8, compute_dtype="float64")
+    tpch.load_into_session(s, sf=0.002, seed=7, shard_count=8)
+    return s
+
+
+@pytest.mark.parametrize("name", sorted(PLANS))
+def test_golden_plan(sess, name):
+    sql = PLANS[name]
+    result = sess.execute(f"explain {sql}")
+    got = "\n".join(str(row[0]) for row in result.rows()) + "\n"
+    path = os.path.join(GOLDEN_DIR, f"{name}.txt")
+    if os.environ.get("GOLDEN_UPDATE"):
+        os.makedirs(GOLDEN_DIR, exist_ok=True)
+        with open(path, "w") as f:
+            f.write(got)
+        return
+    assert os.path.exists(path), \
+        f"golden file missing; run GOLDEN_UPDATE=1 pytest {__file__}"
+    with open(path) as f:
+        want = f.read()
+    assert got == want, (
+        f"plan for {name!r} changed.\n--- golden ---\n{want}"
+        f"--- current ---\n{got}"
+        f"(intentional? GOLDEN_UPDATE=1 pytest tests/test_golden_plans.py)")

@@ -1,0 +1,45 @@
+"""Pallas aggregation kernel: correctness in interpreter mode (CPU CI).
+
+Real-hardware timing lives in bench_kernels.py; this only pins semantics
+(padding, trash-slot handling, K chunking boundaries) against the numpy
+oracle."""
+
+import numpy as np
+import pytest
+
+from citus_tpu.ops.pallas_kernels import (
+    dense_grid_aggregate_pallas,
+    pallas_available,
+    segment_sum_reference,
+)
+
+pytestmark = pytest.mark.skipif(not pallas_available(),
+                                reason="pallas unavailable")
+
+
+@pytest.mark.parametrize("n,total", [
+    (100, 5),          # tiny, sub-tile
+    (3000, 16),        # multi-tile rows
+    (5000, 513),       # K crosses a chunk boundary
+    (2048, 1024),      # exact tiles
+])
+def test_matches_numpy_oracle(rng, n, total):
+    slot = rng.integers(0, total + 1, n).astype(np.int32)  # incl. trash
+    vals = rng.uniform(-50, 50, (n, 3)).astype(np.float32)
+    got = np.asarray(dense_grid_aggregate_pallas(
+        slot, vals, total, interpret=True))
+    want = segment_sum_reference(slot, vals, total)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-3)
+
+
+def test_empty_and_single_slot(rng):
+    vals = rng.uniform(0, 1, (64, 2)).astype(np.float32)
+    slot = np.zeros(64, np.int32)
+    got = np.asarray(dense_grid_aggregate_pallas(
+        slot, vals, 1, interpret=True))
+    np.testing.assert_allclose(got[0], vals.sum(axis=0), rtol=1e-5)
+    # all rows in the trash slot → zeros
+    slot_trash = np.full(64, 3, np.int32)
+    got = np.asarray(dense_grid_aggregate_pallas(
+        slot_trash, vals, 3, interpret=True))
+    assert np.abs(got).sum() == 0
