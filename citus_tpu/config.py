@@ -115,6 +115,12 @@ _register(ConfigVar(
     "Fixed per-device rows per stream batch (0 = size from the "
     "max_feed_bytes_per_device budget). Test/tuning knob.",
     int, min_value=0, max_value=1 << 30))
+_register(ConfigVar(
+    "max_plan_buffer_bytes", 32 << 30,
+    "Reject plans whose largest static device buffer would exceed this "
+    "(cartesian/extreme-fanout protection: a clean error instead of an "
+    "allocator OOM). 0 disables the guard.",
+    int, min_value=0, max_value=1 << 44))
 
 # --- columnar storage (ref: columnar GUCs + columnar.options catalog) -----
 _register(ConfigVar(

@@ -61,7 +61,67 @@ def _scan_ids(plan: QueryPlan) -> list[int]:
     return [id(n) for n in walk_plan(plan.root) if isinstance(n, ScanNode)]
 
 
-def flatten_feed_arrays(plan: QueryPlan, feeds) -> list:
+def collect_device_params(plan: QueryPlan) -> list:
+    """BParam nodes reachable by the traced program, sorted by index.
+
+    Walks every expression the device program evaluates (scan filters,
+    projections, join keys/residuals, window specs, aggregates, and the
+    device-topk ORDER BY keys).  Host-only expressions (host_select,
+    HAVING) evaluate from the bound values and need no program input."""
+    from ..planner import expr as ir
+
+    from .feed import walk_plan
+
+    found: dict[int, object] = {}
+
+    def visit(e):
+        if e is None:
+            return
+        for n in ir.walk(e):
+            if isinstance(n, ir.BParam):
+                found[n.idx] = n
+
+    for node in walk_plan(plan.root):
+        if isinstance(node, ScanNode):
+            visit(node.filter)
+        elif isinstance(node, ProjectNode):
+            for e, _cid in node.exprs:
+                visit(e)
+        elif isinstance(node, JoinNode):
+            for e in list(node.left_keys) + list(node.right_keys):
+                visit(e)
+            visit(node.residual)
+            visit(node.left_match_filter)
+            visit(node.right_match_filter)
+        elif isinstance(node, WindowNode):
+            for w, _cid in node.functions:
+                visit(w)
+            for p in node.partition_by:
+                visit(p)
+        elif isinstance(node, AggregateNode):
+            for g, _cid in node.group_keys:
+                visit(g)
+            for a, _cid in node.aggs:
+                visit(a)
+    if plan.device_topk is not None:
+        for e, _d, _nf in plan.host_order_by:
+            visit(e)
+    return [found[i] for i in sorted(found)]
+
+
+def param_feed_arrays(plan: QueryPlan, compute_dtype) -> list:
+    """One [1] host array per device param, in collect order (appended
+    after the scan feeds; replicated across the mesh)."""
+    out = []
+    for p in collect_device_params(plan):
+        dt = np.dtype(p.dtype.numpy_dtype)
+        if dt == np.float64 and compute_dtype is not None:
+            dt = np.dtype(compute_dtype)
+        out.append(np.asarray([p.value], dtype=dt))
+    return out
+
+
+def flatten_feed_arrays(plan: QueryPlan, feeds, compute_dtype=None) -> list:
     """Feed arrays in the exact order PlanCompiler.build consumes them —
     lets a plan-cache hit skip rebuilding the compiler entirely."""
     out = []
@@ -72,6 +132,7 @@ def flatten_feed_arrays(plan: QueryPlan, feeds) -> list:
         for cid in sorted(feed.nulls):
             out.append(feed.nulls[cid])
         out.append(feed.valid)
+    out.extend(param_feed_arrays(plan, compute_dtype))
     return out
 
 
@@ -217,6 +278,13 @@ class PlanCompiler:
         self._feed_index = feed_index
         self._feed_sharded = {nid: self.feeds[nid].sharded
                               for nid in feed_index}
+        # prepared-statement params ride as replicated [1] inputs AFTER
+        # the feeds: the executable is generic over their values (see
+        # planner/expr.py BParam)
+        self._param_idx = [p.idx for p in collect_device_params(self.plan)]
+        n_params = len(self._param_idx)
+        feed_arrays.extend(param_feed_arrays(self.plan, self.compute_dtype))
+        in_specs.extend([P()] * n_params)
 
         out_cids = sorted(self.plan.root.out_columns)
         out_specs = ({c: P(SHARD_AXIS) for c in out_cids},
@@ -227,22 +295,33 @@ class PlanCompiler:
             # trace-time device float policy: SQL float64 evaluates in the
             # session compute dtype on device (thread-local — tracing runs
             # on the calling thread)
-            from .exprs import set_device_float64
+            from .exprs import set_device_float64, set_device_params
 
             set_device_float64(self.compute_dtype)
-            blocks = self._unpack_feeds(flat_feeds)
-            self._overflow = jnp.zeros((), dtype=jnp.int64)
-            self._dense_oob = jnp.zeros((), dtype=jnp.int64)
-            out = self._exec(self.plan.root, blocks)
-            if self.plan.root.dist.kind == "replicated":
-                # every device holds identical rows; emit from device 0 only
-                out = out.with_filter(
-                    jnp.broadcast_to(
-                        jax.lax.axis_index(SHARD_AXIS) == 0,
-                        out.valid.shape))
-            topk = self.plan.device_topk
-            if topk is not None and out.valid.shape[0] > topk:
-                out = self._device_topk(out, topk)
+            if n_params:
+                param_args = flat_feeds[-n_params:]
+                flat_feeds = flat_feeds[:-n_params]
+                set_device_params({idx: arr[0] for idx, arr in
+                                   zip(self._param_idx, param_args)})
+            try:
+                blocks = self._unpack_feeds(flat_feeds)
+                self._overflow = jnp.zeros((), dtype=jnp.int64)
+                self._dense_oob = jnp.zeros((), dtype=jnp.int64)
+                out = self._exec(self.plan.root, blocks)
+                if self.plan.root.dist.kind == "replicated":
+                    # every device holds identical rows; emit from
+                    # device 0 only
+                    out = out.with_filter(
+                        jnp.broadcast_to(
+                            jax.lax.axis_index(SHARD_AXIS) == 0,
+                            out.valid.shape))
+                topk = self.plan.device_topk
+                if topk is not None and out.valid.shape[0] > topk:
+                    out = self._device_topk(out, topk)
+            finally:
+                # traced scalars must not leak into host-side evaluation
+                # on this thread after the trace completes
+                set_device_params(None)
             cols = {cid: jnp.broadcast_to(out.columns[cid],
                                           out.valid.shape)[None, :]
                     for cid in out_cids}

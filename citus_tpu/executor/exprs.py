@@ -38,6 +38,18 @@ def set_device_float64(dtype) -> None:
     _device_float.dtype = np.dtype(dtype)
 
 
+# prepared-statement parameters at trace time: the compiler binds traced
+# scalars per param index before tracing the plan body, so BParam nodes
+# lower to program INPUTS (generic plans — one executable, any values).
+# Host-side evaluation leaves this unset and falls back to the bound
+# value carried on the node.
+_device_params = threading.local()
+
+
+def set_device_params(params: dict | None) -> None:
+    _device_params.values = params
+
+
 def _dt(e_dtype: DataType, xp):
     name = _NP_DTYPE[e_dtype]
     if name == "float64" and xp is not np:
@@ -71,6 +83,11 @@ def evaluate(e: ir.BExpr, src: ColumnSource, xp):
                     xp.ones((), dtype=bool))
         return (xp.asarray(e.value, dtype=_dt(e.dtype, xp)),
                 None)
+    if isinstance(e, ir.BParam):
+        traced = getattr(_device_params, "values", None)
+        if traced is not None and e.idx in traced:
+            return traced[e.idx].astype(_dt(e.dtype, xp)), None
+        return (xp.asarray(e.value, dtype=_dt(e.dtype, xp)), None)
     if isinstance(e, ir.BArith):
         lv, ln = evaluate(e.left, src, xp)
         rv, rn = evaluate(e.right, src, xp)

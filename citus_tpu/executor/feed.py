@@ -64,15 +64,22 @@ def skippable_tests(filter_expr) -> tuple:
     if filter_expr is None:
         return ()
     _FLIP = {"<": ">", "<=": ">=", ">": "<", ">=": "<=", "=": "="}
+    # BParam carries its bound value: chunk skipping is host-side per
+    # execution, so generic plans keep min/max pruning (and the feed
+    # cache keys on the VALUE, as it must — different values read
+    # different chunks)
+    const_types = (ir.BConst, ir.BParam)
     tests: list[tuple[str, str, object]] = []
     for c in ir.split_conjuncts(filter_expr):
         if isinstance(c, ir.BCmp) and c.op in _FLIP:
-            if isinstance(c.left, ir.BCol) and isinstance(c.right, ir.BConst) \
+            if isinstance(c.left, ir.BCol) \
+                    and isinstance(c.right, const_types) \
                     and c.right.value is not None:
                 tests.append((c.left.cid.split(".", 1)[1], c.op,
                               c.right.value))
             elif isinstance(c.right, ir.BCol) and \
-                    isinstance(c.left, ir.BConst) and c.left.value is not None:
+                    isinstance(c.left, const_types) \
+                    and c.left.value is not None:
                 tests.append((c.right.cid.split(".", 1)[1], _FLIP[c.op],
                               c.left.value))
         elif isinstance(c, ir.BInConst) and not c.negated and \

@@ -17,7 +17,7 @@ from jax.sharding import Mesh
 
 from ..catalog import Catalog
 from ..config import Settings
-from ..errors import CapacityOverflowError, ExecutionError
+from ..errors import CapacityOverflowError, ExecutionError, PlanningError
 from ..planner import expr as ir
 from ..planner.plan import (
     AggregateNode,
@@ -142,8 +142,18 @@ class Executor:
         path.  Returns (packed, out_meta, converged_caps, retries);
         converged capacities are memoized under `fingerprint` whenever a
         retry occurred so later executions start warm."""
+        limit = self.settings.get("max_plan_buffer_bytes")
         retries = 0
         while True:
+            if limit:
+                est = _plan_buffer_bytes(plan, caps)
+                if est > limit:
+                    raise PlanningError(
+                        f"plan needs ~{est / 1e9:.1f} GB of device "
+                        f"buffers (max_plan_buffer_bytes = "
+                        f"{limit / 1e9:.1f} GB) — usually a cartesian "
+                        "or extreme-fanout join; rewrite the query or "
+                        "raise the limit")
             key = fingerprint + (caps_signature(plan, caps),)
             entry = self.plan_cache.get(key)
             if entry is None:
@@ -153,13 +163,23 @@ class Executor:
                 self.plan_cache.put(key, (fn, out_meta))
             else:
                 fn, out_meta = entry
-                feed_arrays = flatten_feed_arrays(plan, feeds)
+                feed_arrays = flatten_feed_arrays(plan, feeds,
+                                                  compute_dtype)
             # two device→host transfers total: the bit-packed output block
             # and the overflow counters (each transfer pays a full round
             # trip on remote-attached TPUs)
             import jax
 
-            packed, overflow = jax.device_get(fn(*feed_arrays))
+            try:
+                packed, overflow = jax.device_get(fn(*feed_arrays))
+            except jax.errors.JaxRuntimeError as e:
+                # remote-attached compile services flake transiently on
+                # long compilations (connection drops mid-response); one
+                # clean retry re-issues the compile.  Anything else, or a
+                # second failure, propagates.
+                if "remote_compile" not in str(e):
+                    raise
+                packed, overflow = jax.device_get(fn(*feed_arrays))
             ov = np.asarray(overflow).reshape(-1, 2).sum(axis=0)
             cap_overflow, dense_oob = int(ov[0]), int(ov[1])
             if cap_overflow == 0 and dense_oob == 0:
@@ -454,6 +474,25 @@ class Executor:
         while f"{name}_{i}" in taken:
             i += 1
         return f"{name}_{i}"
+
+
+def _plan_buffer_bytes(plan: QueryPlan, caps: Capacities) -> int:
+    """Worst single-buffer estimate for a capacity assignment: each
+    join/repartition/aggregate buffer holds its node's output columns at
+    the static capacity, per device.  Guards against executing plans
+    whose shapes could never fit (a 2G-slot cartesian output would
+    otherwise OOM — or segfault — the backend allocator)."""
+    nodes = {id(n): n for n in walk_plan(plan.root)}
+    worst = 0
+    for table, factor in ((caps.join_out, 1), (caps.repartition,
+                                               plan.n_devices),
+                          (caps.agg_out, 1), (caps.scan_out, 1)):
+        for nid, cap in table.items():
+            node = nodes.get(nid)
+            ncols = len(node.out_columns) if node is not None else 4
+            worst = max(worst,
+                        cap * factor * (ncols + 2) * 8 * plan.n_devices)
+    return worst
 
 
 def _decode_strings(d, codes, nmask) -> np.ndarray:

@@ -231,9 +231,15 @@ def _convert_column(session, table, name, dtype: DataType, cells,
                 f"column {name!r}: string column fed a numeric array")
         return (cells.astype(dtype.numpy_dtype, copy=False),
                 np.ones(n, dtype=bool))
-    valid = np.array([c is not None and not (isinstance(c, str) and c == "")
-                      if not pre_typed else c is not None
-                      for c in cells], dtype=bool)
+    # list.count(None) is a C-level scan: the common bulk case (no NULLs
+    # at all) skips the per-value Python validity comprehension entirely
+    if pre_typed and isinstance(cells, list) and cells.count(None) == 0:
+        valid = np.ones(n, dtype=bool)
+    else:
+        valid = np.array(
+            [c is not None and not (isinstance(c, str) and c == "")
+             if not pre_typed else c is not None
+             for c in cells], dtype=bool)
     if dtype == DataType.STRING:
         d = session.store.dictionary(table, name)
         if valid.all():

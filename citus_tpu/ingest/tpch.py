@@ -371,4 +371,125 @@ group by nation, o_year
 order by nation, o_year desc
 """
 
-QUERIES = {"Q1": Q1, "Q3": Q3, "Q5": Q5, "Q6": Q6, "Q9": Q9}
+Q7 = """
+select supp_nation, cust_nation, l_year, sum(volume) as revenue
+from (select n1.n_name as supp_nation, n2.n_name as cust_nation,
+             extract(year from l_shipdate) as l_year,
+             l_extendedprice * (1 - l_discount) as volume
+      from supplier, lineitem, orders, customer, nation n1, nation n2
+      where s_suppkey = l_suppkey and o_orderkey = l_orderkey
+        and c_custkey = o_custkey and s_nationkey = n1.n_nationkey
+        and c_nationkey = n2.n_nationkey
+        and ((n1.n_name = 'FRANCE' and n2.n_name = 'GERMANY')
+          or (n1.n_name = 'GERMANY' and n2.n_name = 'FRANCE'))
+        and l_shipdate >= date '1995-01-01'
+        and l_shipdate <= date '1996-12-31') shipping
+group by supp_nation, cust_nation, l_year
+order by supp_nation, cust_nation, l_year
+"""
+
+Q8 = """
+select o_year,
+       sum(case when nation = 'BRAZIL' then volume else 0 end)
+         / sum(volume) as mkt_share
+from (select extract(year from o_orderdate) as o_year,
+             l_extendedprice * (1 - l_discount) as volume,
+             n2.n_name as nation
+      from part, supplier, lineitem, orders, customer,
+           nation n1, nation n2, region
+      where p_partkey = l_partkey and s_suppkey = l_suppkey
+        and l_orderkey = o_orderkey and o_custkey = c_custkey
+        and c_nationkey = n1.n_nationkey
+        and n1.n_regionkey = r_regionkey and r_name = 'AMERICA'
+        and s_nationkey = n2.n_nationkey
+        and o_orderdate >= date '1995-01-01'
+        and o_orderdate <= date '1996-12-31'
+        and p_type = 'ECONOMY ANODIZED STEEL') all_nations
+group by o_year
+order by o_year
+"""
+
+Q10 = """
+select c_custkey, c_name,
+       sum(l_extendedprice * (1 - l_discount)) as revenue,
+       c_acctbal, n_name, c_address, c_phone, c_comment
+from customer, orders, lineitem, nation
+where c_custkey = o_custkey and l_orderkey = o_orderkey
+  and o_orderdate >= date '1993-10-01'
+  and o_orderdate < date '1993-10-01' + interval '3' month
+  and l_returnflag = 'R' and c_nationkey = n_nationkey
+group by c_custkey, c_name, c_acctbal, c_phone, n_name, c_address,
+         c_comment
+order by revenue desc, c_custkey
+limit 20
+"""
+
+Q12 = """
+select l_shipmode,
+       sum(case when o_orderpriority = '1-URGENT'
+                  or o_orderpriority = '2-HIGH'
+                then 1 else 0 end) as high_line_count,
+       sum(case when o_orderpriority <> '1-URGENT'
+                 and o_orderpriority <> '2-HIGH'
+                then 1 else 0 end) as low_line_count
+from orders, lineitem
+where o_orderkey = l_orderkey and l_shipmode in ('MAIL', 'SHIP')
+  and l_commitdate < l_receiptdate and l_shipdate < l_commitdate
+  and l_receiptdate >= date '1994-01-01'
+  and l_receiptdate < date '1994-01-01' + interval '1' year
+group by l_shipmode
+order by l_shipmode
+"""
+
+Q14 = """
+select 100.00 * sum(case when p_type like 'PROMO%'
+                         then l_extendedprice * (1 - l_discount)
+                         else 0 end)
+       / sum(l_extendedprice * (1 - l_discount)) as promo_revenue
+from lineitem, part
+where l_partkey = p_partkey
+  and l_shipdate >= date '1995-09-01'
+  and l_shipdate < date '1995-09-01' + interval '1' month
+"""
+
+Q18 = """
+select c_name, c_custkey, o_orderkey, o_orderdate, o_totalprice,
+       sum(l_quantity)
+from customer, orders, lineitem
+where o_orderkey in (select l_orderkey from lineitem
+                     group by l_orderkey having sum(l_quantity) > 212)
+  and c_custkey = o_custkey and o_orderkey = l_orderkey
+group by c_name, c_custkey, o_orderkey, o_orderdate, o_totalprice
+order by o_totalprice desc, o_orderdate, o_orderkey
+limit 100
+"""
+
+# Q19 in the standard factored form (join predicate outside the OR; the
+# textbook text repeats `p_partkey = l_partkey` inside each branch)
+Q19 = """
+select sum(l_extendedprice * (1 - l_discount)) as revenue
+from lineitem, part
+where p_partkey = l_partkey
+  and ((p_brand = 'Brand#12'
+        and p_container in ('SM CASE', 'SM BOX')
+        and l_quantity >= 1 and l_quantity <= 11
+        and p_size between 1 and 5
+        and l_shipmode in ('AIR', 'REG AIR')
+        and l_shipinstruct = 'DELIVER IN PERSON')
+    or (p_brand = 'Brand#23'
+        and p_container in ('MED BAG', 'MED BOX')
+        and l_quantity >= 10 and l_quantity <= 20
+        and p_size between 1 and 10
+        and l_shipmode in ('AIR', 'REG AIR')
+        and l_shipinstruct = 'DELIVER IN PERSON')
+    or (p_brand = 'Brand#34'
+        and p_container in ('LG CASE', 'LG BOX')
+        and l_quantity >= 20 and l_quantity <= 30
+        and p_size between 1 and 15
+        and l_shipmode in ('AIR', 'REG AIR')
+        and l_shipinstruct = 'DELIVER IN PERSON'))
+"""
+
+QUERIES = {"Q1": Q1, "Q3": Q3, "Q5": Q5, "Q6": Q6, "Q7": Q7, "Q8": Q8,
+           "Q9": Q9, "Q10": Q10, "Q12": Q12, "Q14": Q14, "Q18": Q18,
+           "Q19": Q19}

@@ -107,9 +107,12 @@ MISSING_CODE = -2  # equality target for strings absent from the dictionary
 
 
 class Binder:
-    def __init__(self, catalog: Catalog, dicts: DictProvider):
+    def __init__(self, catalog: Catalog, dicts: DictProvider,
+                 params: tuple = ()):
         self.catalog = catalog
         self.dicts = dicts
+        # prepared-statement argument values ($1 → params[0]); see BParam
+        self.params = params
 
     # -- entry -------------------------------------------------------------
     def bind_select(self, sel: ast.Select) -> BoundQuery:
@@ -169,6 +172,9 @@ class Binder:
             raise PlanningError("HAVING requires GROUP BY or aggregates")
         if is_aggregate:
             self._check_grouping(select, group_by)
+
+        conjuncts, outer_joins, nullable = _reduce_outer_joins(
+            conjuncts, outer_joins, nullable)
 
         return BoundQuery(rels=rels, conjuncts=conjuncts, select=select,
                           group_by=group_by, having=having,
@@ -265,6 +271,8 @@ class Binder:
         # GROUP BY); SELECT items / HAVING / ORDER BY allow aggregates
         if isinstance(e, ast.Literal):
             return self._bind_literal(e)
+        if isinstance(e, ast.Param):
+            return self._bind_param(e)
         if isinstance(e, ast.ColumnRef):
             return scope.resolve(e)
         if isinstance(e, ast.BinaryOp):
@@ -368,6 +376,24 @@ class Binder:
         if isinstance(e.value, float):
             return ir.BConst(e.value, DataType.FLOAT64)
         return ir.BConst(str(e.value), DataType.STRING)
+
+    def _bind_param(self, e: ast.Param) -> ir.BExpr:
+        if e.index >= len(self.params):
+            raise PlanningError(
+                f"parameter ${e.index + 1} has no value (statement has "
+                f"{len(self.params)} argument(s) — is it running outside "
+                "EXECUTE?)")
+        lit = self.params[e.index]
+        if not isinstance(lit, ast.Literal):
+            raise PlanningError("EXECUTE arguments must be literals")
+        const = self._bind_literal(lit)
+        # strings (dictionary-code rewrites) and NULLs stay plain
+        # constants — their translation machinery is literal-driven; the
+        # generic-plan win targets numeric/date/bool parameters
+        if const.dtype == DataType.STRING or const.value is None \
+                or isinstance(const.value, tuple):
+            return const
+        return ir.BParam(e.index, const.dtype, const.value)
 
     def _bind_binary(self, e: ast.BinaryOp, scope: "_Scope",
                      allow_agg: bool = True) -> ir.BExpr:
@@ -526,6 +552,11 @@ class Binder:
             return e
         if isinstance(e, ir.BConst):
             return _coerce_const_expr(e, dtype)
+        if isinstance(e, ir.BParam):
+            # coerce the VALUE and stay a param (a BCast wrapper would
+            # hide the node from pruning / chunk-skip matching)
+            coerced = _coerce_const_expr(ir.BConst(e.value, e.dtype), dtype)
+            return ir.BParam(e.idx, dtype, coerced.value)
         return ir.BCast(e, dtype)
 
     def _expect_str_literal(self, e: ast.Expr) -> str:
@@ -670,3 +701,112 @@ def _days_in_month(y: int, m: int) -> int:
     import calendar
 
     return calendar.monthrange(y, m)[1]
+
+
+# -- outer-join reduction ---------------------------------------------------
+
+def _null_propagating_rels(e: ir.BExpr) -> frozenset[int]:
+    """Relations R such that a NULL in a referenced column of R forces
+    `e` itself to evaluate to NULL.  Arithmetic, casts, extract and
+    column references propagate; CASE, IS NULL, boolean logic and any
+    unknown node kind can absorb a NULL into a non-NULL result, so
+    recursion STOPS there (collecting their columns would wrongly mark
+    null-tolerant predicates as strict)."""
+    if isinstance(e, ir.BCol):
+        return (frozenset((e.rel_index,)) if e.rel_index >= 0
+                else frozenset())
+    if isinstance(e, ir.BArith):
+        return _null_propagating_rels(e.left) | \
+            _null_propagating_rels(e.right)
+    if isinstance(e, (ir.BCast, ir.BExtract)):
+        return _null_propagating_rels(e.operand)
+    return frozenset()  # constants, params, CASE, IS NULL, bool, agg, …
+
+
+def _strict_rels(e: ir.BExpr) -> frozenset[int]:
+    """Relations in which predicate `e` is null-rejecting: a NULL in any
+    null-propagating referenced column of such a rel makes the predicate
+    non-TRUE, so the row cannot survive WHERE/inner-ON filtering.
+    Comparisons and IN are strict in the rels their null-propagating
+    operands reference; AND unions, OR intersects, NOT passes through
+    (NOT NULL is NULL); IS NULL and unknown node kinds are never
+    strict."""
+    if isinstance(e, ir.BCmp):
+        return _null_propagating_rels(e.left) | \
+            _null_propagating_rels(e.right)
+    if isinstance(e, ir.BInConst):
+        return _null_propagating_rels(e.operand)
+    if isinstance(e, ir.BBool):
+        parts = [_strict_rels(a) for a in e.args]
+        if not parts:
+            return frozenset()
+        if e.op == "AND":
+            return frozenset().union(*parts)
+        if e.op == "OR":
+            out = parts[0]
+            for p in parts[1:]:
+                out &= p
+            return out
+        return parts[0]  # NOT
+    return frozenset()
+
+
+def _reduce_outer_joins(conjuncts, outer_joins, nullable):
+    """Demote outer joins whose null-extended side cannot survive later
+    strict predicates (the reduce_outer_joins transformation; the
+    reference inherits it from PostgreSQL's planner prep).  A LEFT join
+    whose nullable rel is referenced by a strict WHERE / inner-ON
+    conjunct is really an inner join — demoting it frees the join-order
+    search to use that rel's equi-join edges instead of falling into
+    cartesian orders (and matches SQL semantics exactly).
+
+    FULL joins reduce one side at a time (strict on the right side ⇒
+    only the right-preserving half survives ⇒ RIGHT; and vice versa).
+    Demoted ON conditions join the inner-conjunct pool, which may
+    cascade further reductions — iterate to a fixpoint."""
+    conjuncts = list(conjuncts)
+    specs = list(outer_joins)
+    changed = True
+    while changed and specs:
+        changed = False
+        strict: frozenset[int] = frozenset()
+        for c in conjuncts:
+            strict |= _strict_rels(c)
+        for i, spec in enumerate(specs):
+            right = frozenset((spec.right_rel_index,))
+            if spec.join_type == "left":
+                reduce_now = bool(strict & right)
+                new_type = "inner"
+            elif spec.join_type == "right":
+                reduce_now = bool(strict & spec.tree_rels)
+                new_type = "inner"
+            else:  # full
+                hit_r = bool(strict & right)
+                hit_t = bool(strict & spec.tree_rels)
+                if hit_r and hit_t:
+                    reduce_now, new_type = True, "inner"
+                elif hit_r:
+                    specs[i] = OuterJoinSpec("left", spec.tree_rels,
+                                             spec.right_rel_index, spec.on)
+                    changed = True
+                    continue
+                elif hit_t:
+                    specs[i] = OuterJoinSpec("right", spec.tree_rels,
+                                             spec.right_rel_index, spec.on)
+                    changed = True
+                    continue
+                else:
+                    reduce_now = False
+                    new_type = "inner"
+            if reduce_now and new_type == "inner":
+                conjuncts.extend(spec.on)
+                del specs[i]
+                changed = True
+                break
+    new_nullable: set[int] = set()
+    for spec in specs:
+        if spec.join_type in ("left", "full"):
+            new_nullable.add(spec.right_rel_index)
+        if spec.join_type in ("right", "full"):
+            new_nullable.update(spec.tree_rels)
+    return conjuncts, specs, new_nullable

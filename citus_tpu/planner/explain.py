@@ -73,6 +73,8 @@ def _format_node(node: PlanNode, lines: list[str], depth: int) -> None:
         return
     if isinstance(node, JoinNode):
         label = _JOIN_LABEL.get(node.strategy, node.strategy)
+        if node.join_type != "inner":
+            label = f"{node.join_type.capitalize()} Outer {label}"
         conds = ", ".join(f"{l} = {r}" for l, r in
                           zip(node.left_keys, node.right_keys))
         from ..ops.join import dense_directory_ok

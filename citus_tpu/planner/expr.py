@@ -13,7 +13,7 @@ WHERE treats NULL as false.
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Optional
 
 from ..types import DataType
@@ -48,6 +48,29 @@ class BConst(BExpr):
 
     def __str__(self):
         return repr(self.value)
+
+
+@dataclass(frozen=True, repr=False)
+class BParam(BExpr):
+    """Prepared-statement parameter: a runtime scalar the compiled
+    program takes as an INPUT rather than a baked literal, so one
+    compiled plan serves every EXECUTE (the generic-plan analogue of the
+    reference's prepared shard plans, planner/local_plan_cache.c).
+
+    The bound VALUE rides along for host-side uses (shard pruning, chunk
+    skipping, fast-path routing, host combine) but is excluded from
+    repr/eq — plan fingerprints and compiled-plan cache keys must not
+    see it."""
+
+    idx: int
+    dtype: DataType
+    value: object = field(compare=False, default=None)
+
+    def __repr__(self):
+        return f"BParam({self.idx}, {self.dtype})"
+
+    def __str__(self):
+        return f"${self.idx + 1}"
 
 
 @dataclass(frozen=True)

@@ -470,9 +470,12 @@ class DistributedPlanner:
         candidates: Optional[set[int]] = None
         for f in filters:
             values = None
+            # BParam counts: pruning is host-side per execution, so the
+            # bound value is usable even in a generic plan (the deferred
+            # param-pruning of CitusBeginScan, citus_custom_scan.c:213)
             if (isinstance(f, ir.BCmp) and f.op == "="
                     and isinstance(f.left, ir.BCol) and f.left.cid == dist_cid
-                    and isinstance(f.right, ir.BConst)
+                    and isinstance(f.right, (ir.BConst, ir.BParam))
                     and f.right.value is not None):
                 values = [f.right.value]
             elif (isinstance(f, ir.BInConst) and not f.negated

@@ -43,6 +43,13 @@ def ensure_jax_configured(platform: str | None = None,
         # in-process plan cache covers repeats there anyway.
         plat = (platform or str(getattr(jax.config, "jax_platforms", "")
                                 or os.environ.get("JAX_PLATFORMS") or ""))
+        if not plat:
+            # nothing configured explicitly: ask the backend (a plain
+            # CPU-only machine must hit the cpu opt-out too)
+            try:
+                plat = jax.default_backend()
+            except Exception:
+                plat = ""
         cache_dir = os.environ.get(
             "CITUS_TPU_COMPILE_CACHE",
             os.path.join(os.path.expanduser("~"), ".cache",

@@ -53,7 +53,8 @@ _UDFS = ("create_distributed_table", "create_reference_table",
          "citus_cleanup_orphaned_resources",
          "citus_rebalance_start", "citus_rebalance_wait",
          "citus_job_wait", "citus_job_cancel", "citus_job_list",
-         "citus_change_feed", "citus_create_restore_point")
+         "citus_change_feed", "citus_create_restore_point",
+         "citus_tables", "citus_shards")
 
 
 class _StoreStats(StatsProvider):
@@ -95,7 +96,13 @@ class _StoreDicts(DictProvider):
 class Session:
     def __init__(self, data_dir: str | None = None,
                  n_devices: int | None = None, platform: str | None = None,
-                 **settings):
+                 mesh=None, **settings):
+        """`mesh` accepts an externally built single-axis
+        jax.sharding.Mesh — the multi-host path: initialize
+        jax.distributed on every host, build one global Mesh over all
+        chips (ICI within hosts, DCN across), and hand it in; the
+        executor's collectives ride it unchanged (SURVEY §2.6 TPU-native
+        comm backend)."""
         ensure_jax_configured(platform=platform)
         self.data_dir = data_dir or tempfile.mkdtemp(prefix="citus_tpu_")
         os.makedirs(self.data_dir, exist_ok=True)
@@ -104,16 +111,30 @@ class Session:
         self.catalog = (Catalog.load(cat_path) if os.path.exists(cat_path)
                         else Catalog())
         self.store = TableStore(self.data_dir, self.catalog)
-        from .distributed.mesh import make_mesh
+        from .distributed.mesh import SHARD_AXIS, make_mesh
 
-        self.mesh = make_mesh(n_devices)
+        if mesh is not None:
+            if tuple(mesh.axis_names) != (SHARD_AXIS,):
+                raise CatalogError(
+                    f"external mesh must have the single axis "
+                    f"{SHARD_AXIS!r}, got {mesh.axis_names}")
+            self.mesh = mesh
+        else:
+            self.mesh = make_mesh(n_devices)
         self.n_devices = len(self.mesh.devices.flatten())
         if not self.catalog.nodes:
             for i in range(self.n_devices):
                 self.catalog.add_node(f"device:{i}")
         import itertools
+        import threading
 
         self._temp_counter = itertools.count(1)
+        # PREPARE registry: name → statement AST (session-scoped, like PG)
+        self._prepared: dict[str, ast.Statement] = {}
+        # EXECUTE args visible to recursive planning (subqueries run
+        # BEFORE the outer binder sees the params; thread-local because
+        # Session.execute supports concurrent callers)
+        self._params_tls = threading.local()
         from .executor.runner import Executor
         from .stats import SessionStats
 
@@ -267,6 +288,18 @@ class Session:
             return self._execute_explain(stmt)
         if isinstance(stmt, ast.TransactionStmt):
             return self._execute_transaction_stmt(stmt)
+        if isinstance(stmt, ast.Prepare):
+            self._prepared[stmt.name] = stmt.statement
+            return None
+        if isinstance(stmt, ast.ExecutePrepared):
+            return self._execute_prepared(stmt)
+        if isinstance(stmt, ast.Deallocate):
+            if stmt.name == "all":
+                self._prepared.clear()
+            elif self._prepared.pop(stmt.name, None) is None:
+                raise PlanningError(
+                    f"prepared statement {stmt.name!r} does not exist")
+            return None
         if isinstance(stmt, ast.SetVariable):
             self.settings.set(stmt.name, stmt.value)
             return None
@@ -376,6 +409,48 @@ class Session:
             from .transaction.clock import global_clock
 
             return ResultSet(["clock"], {"clock": [global_clock.now()]}, 1)
+        elif e.name == "citus_tables":
+            # the citus_tables view (ref: sql UDF surface, SURVEY §1.1)
+            names = sorted(self.catalog.tables)
+            kinds, dcols, colo, sizes, shards = [], [], [], [], []
+            for t in names:
+                m = self.catalog.table(t)
+                kinds.append(m.method.value)
+                dcols.append(m.distribution_column or "")
+                colo.append(m.colocation_id)
+                tshards = self.catalog.table_shards(t)
+                shards.append(len(tshards))
+                sizes.append(sum(
+                    self.store.shard_size_bytes(t, s.shard_id)
+                    for s in tshards))
+            return ResultSet(
+                ["table_name", "citus_table_type", "distribution_column",
+                 "colocation_id", "shard_count", "table_size_bytes"],
+                {"table_name": names, "citus_table_type": kinds,
+                 "distribution_column": dcols, "colocation_id": colo,
+                 "shard_count": shards, "table_size_bytes": sizes},
+                len(names))
+        elif e.name == "citus_shards":
+            # the citus_shards view: one row per shard with placement
+            rows: list[tuple] = []
+            tables = ([str(args[0])] if args
+                      else sorted(self.catalog.tables))
+            for t in tables:
+                for s in self.catalog.table_shards(t):
+                    p = self.catalog.active_placement(s.shard_id)
+                    rows.append((
+                        t, s.shard_id, s.min_value, s.max_value,
+                        f"device:{p.node_id}" if p else "",
+                        self.store.shard_size_bytes(t, s.shard_id),
+                        self.store.shard_row_count(t, s.shard_id)))
+            cols = list(zip(*rows)) if rows else [[]] * 7
+            return ResultSet(
+                ["table_name", "shard_id", "min_value", "max_value",
+                 "node", "size_bytes", "live_rows"],
+                {"table_name": list(cols[0]), "shard_id": list(cols[1]),
+                 "min_value": list(cols[2]), "max_value": list(cols[3]),
+                 "node": list(cols[4]), "size_bytes": list(cols[5]),
+                 "live_rows": list(cols[6])}, len(rows))
         elif e.name == "citus_change_feed":
             table = str(args[0]) if args else None
             from_lsn = int(args[1]) if len(args) > 1 else 0
@@ -706,14 +781,33 @@ class Session:
                 self._drop_temp(t)
 
     # -- SELECT ------------------------------------------------------------
-    def _execute_select(self, sel: ast.Select):
-        plan, cleanup = self._plan_select(sel)
+    def _execute_select(self, sel: ast.Select, params: tuple = ()):
+        plan, cleanup = self._plan_select(sel, params)
         self._count_plan_shape(plan)
         try:
             return self.executor.execute_plan(plan)
         finally:
             for t in cleanup:
                 self._drop_temp(t)
+
+    # -- PREPARE / EXECUTE -------------------------------------------------
+    def _execute_prepared(self, stmt: "ast.ExecutePrepared"):
+        """EXECUTE name(args): SELECTs bind args as BParam placeholders so
+        the compiled mesh program is generic over the values (one compile
+        serves every EXECUTE — the reference's cached shard plans,
+        planner/local_plan_cache.c); other statement kinds substitute the
+        literals into the AST (no device compile to reuse there)."""
+        target = self._prepared.get(stmt.name)
+        if target is None:
+            raise PlanningError(
+                f"prepared statement {stmt.name!r} does not exist")
+        for a in stmt.args:
+            if not isinstance(a, ast.Literal):
+                raise PlanningError("EXECUTE arguments must be literals")
+        if isinstance(target, ast.Select):
+            return self._execute_select(target, params=stmt.args)
+        return self._execute_statement(
+            _substitute_params(target, stmt.args))
 
     def _execute_subselect(self, sel: ast.Select):
         """Nested (recursive-planning / MERGE-source) execution: counts as
@@ -746,10 +840,17 @@ class Session:
         else:
             self.stats.counters.increment(sc.QUERIES_MULTI_SHARD)
 
-    def _plan_select(self, sel: ast.Select) -> tuple[QueryPlan, list[str]]:
+    def _plan_select(self, sel: ast.Select,
+                     params: tuple = ()) -> tuple[QueryPlan, list[str]]:
         cleanup: list[str] = []
-        sel = self._recursive_plan(sel, cleanup)
-        binder = Binder(self.catalog, _StoreDicts(self.store))
+        prev = getattr(self._params_tls, "value", ())
+        self._params_tls.value = params
+        try:
+            sel = self._recursive_plan(sel, cleanup)
+        finally:
+            self._params_tls.value = prev
+        binder = Binder(self.catalog, _StoreDicts(self.store),
+                        params=params)
         bound = binder.bind_select(sel)
         planner = DistributedPlanner(
             self.catalog, _StoreStats(self.store), self.n_devices,
@@ -799,12 +900,21 @@ class Session:
                 self._drop_temp(t)
 
     # -- recursive planning ------------------------------------------------
+    def _sub_params(self, node):
+        """Substitute EXECUTE args into a subquery AST before it runs as
+        a subplan (subplans execute ahead of outer binding, so $n must
+        resolve here; the OUTER query's params stay symbolic for the
+        generic plan)."""
+        args = getattr(self._params_tls, "value", ())
+        return _substitute_params(node, args) if args else node
+
     def _recursive_plan(self, sel: ast.Select, cleanup: list[str],
                         cte_scope: dict[str, str] | None = None) -> ast.Select:
         cte_scope = dict(cte_scope or {})
         for cte in sel.ctes:
             inner = self._recursive_plan(cte.query, cleanup, cte_scope)
-            temp = self._materialize(inner, cleanup, cte.column_names)
+            temp = self._materialize(self._sub_params(inner), cleanup,
+                                     cte.column_names)
             cte_scope[cte.name] = temp
         new_from = tuple(self._rewrite_from(fi, cleanup, cte_scope)
                          for fi in sel.from_items)
@@ -830,7 +940,7 @@ class Session:
             return fi
         if isinstance(fi, ast.SubqueryRef):
             inner = self._recursive_plan(fi.query, cleanup, cte_scope)
-            temp = self._materialize(inner, cleanup)
+            temp = self._materialize(self._sub_params(inner), cleanup)
             return ast.TableRef(temp, fi.alias)
         if isinstance(fi, ast.Join):
             return ast.Join(fi.join_type,
@@ -845,7 +955,7 @@ class Session:
     def _rewrite_expr(self, e: ast.Expr, cleanup, cte_scope) -> ast.Expr:
         if isinstance(e, ast.ScalarSubquery):
             inner = self._recursive_plan(e.query, cleanup, cte_scope)
-            result = self._execute_subselect(inner)
+            result = self._execute_subselect(self._sub_params(inner))
             if result.row_count > 1:
                 raise ExecutionError(
                     "scalar subquery returned more than one row")
@@ -855,7 +965,7 @@ class Session:
             return _value_to_literal(result.rows()[0][0], dt)
         if isinstance(e, ast.InSubquery):
             inner = self._recursive_plan(e.query, cleanup, cte_scope)
-            result = self._execute_subselect(inner)
+            result = self._execute_subselect(self._sub_params(inner))
             dt = _result_dtype(result, 0)
             raw = [r[0] for r in result.rows()]
             has_null = any(v is None for v in raw)
@@ -876,7 +986,7 @@ class Session:
             return ast.InList(operand, values, False)
         if isinstance(e, ast.Exists):
             inner = self._recursive_plan(e.query, cleanup, cte_scope)
-            limited = dc_replace(inner, limit=1)
+            limited = dc_replace(self._sub_params(inner), limit=1)
             result = self._execute_subselect(limited)
             found = result.row_count > 0
             return ast.Literal(found != e.negated)
@@ -963,8 +1073,12 @@ class Session:
                 arrays[col_name] = d.intern_array(values)
             arrays = {c: _object_to_typed(a) for c, a in arrays.items()}
             shard = self.catalog.table_shards(name)[0]
-            self.store.append_stripe(name, shard.shard_id, arrays,
-                                     validity)
+            # intermediate results are query plumbing, not logical data
+            # changes — the change feed must not see them (and a read-only
+            # SELECT must not pay a journal fsync)
+            with self.store.change_log.suppress():
+                self.store.append_stripe(name, shard.shard_id, arrays,
+                                         validity)
         return name
 
     def _drop_temp(self, name: str):
@@ -1044,3 +1158,32 @@ def _object_to_typed(arr: np.ndarray) -> np.ndarray:
     if arr.dtype != object:
         return arr
     return np.array([0 if x is None else x for x in arr])
+
+
+def _substitute_params(node, args: tuple):
+    """Replace ast.Param nodes with the EXECUTE argument literals across
+    an arbitrary (frozen-dataclass) statement tree — the non-SELECT
+    prepared-execution path (INSERT/UPDATE/DELETE have no compiled device
+    program to keep generic)."""
+    import dataclasses
+
+    if isinstance(node, ast.Param):
+        if node.index >= len(args):
+            raise PlanningError(
+                f"parameter ${node.index + 1} has no value")
+        return args[node.index]
+    if dataclasses.is_dataclass(node) and not isinstance(node, type):
+        changes = {}
+        for f in dataclasses.fields(node):
+            old = getattr(node, f.name)
+            new = _substitute_params(old, args)
+            if new is not old:
+                changes[f.name] = new
+        return dataclasses.replace(node, **changes) if changes else node
+    if isinstance(node, tuple):
+        subst = tuple(_substitute_params(x, args) for x in node)
+        return subst if any(a is not b for a, b in zip(subst, node)) \
+            else node
+    if isinstance(node, list):
+        return [_substitute_params(x, args) for x in node]
+    return node

@@ -56,6 +56,16 @@ class Literal(Expr):
 
 
 @dataclass(frozen=True)
+class Param(Expr):
+    """Prepared-statement parameter $N (0-based index)."""
+
+    index: int
+
+    def __str__(self):
+        return f"${self.index + 1}"
+
+
+@dataclass(frozen=True)
 class Star(Expr):
     table: Optional[str] = None
 
@@ -495,6 +505,27 @@ class Explain(Statement):
 @dataclass(frozen=True)
 class TransactionStmt(Statement):
     kind: str  # begin | commit | rollback
+
+
+@dataclass(frozen=True)
+class Prepare(Statement):
+    """PREPARE name AS <statement> (ref: PG prepared statements; Citus
+    caches the distributed plan per shard interval,
+    planner/local_plan_cache.c)."""
+
+    name: str
+    statement: Statement
+
+
+@dataclass(frozen=True)
+class ExecutePrepared(Statement):
+    name: str
+    args: tuple = ()  # Literal expressions
+
+
+@dataclass(frozen=True)
+class Deallocate(Statement):
+    name: str  # or "all"
 
 
 @dataclass(frozen=True)

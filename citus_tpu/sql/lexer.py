@@ -76,6 +76,16 @@ def tokenize(sql: str) -> list[Token]:
             i = j + 2
             continue
         start_line, start_col = line, col
+        # prepared-statement parameter: $1, $2, ...
+        if ch == "$" and i + 1 < n and sql[i + 1].isdigit():
+            j = i + 1
+            while j < n and sql[j].isdigit():
+                j += 1
+            tokens.append(Token("param", sql[i + 1:j],
+                                start_line, start_col))
+            col += j - i
+            i = j
+            continue
         # string literal with '' escape
         if ch == "'":
             j = i + 1

@@ -138,7 +138,36 @@ class Parser:
         if self.at_keyword("begin", "start", "commit", "rollback", "abort",
                            "end"):
             return self.parse_transaction()
+        if self.cur.kind in ("ident", "keyword") and \
+                self.cur.value in ("prepare", "execute", "deallocate"):
+            return self.parse_prepared()
         self.error("expected a statement")
+
+    def parse_prepared(self) -> ast.Statement:
+        word = self.cur.value
+        self.advance()
+        if word == "prepare":
+            name = self.expect_ident()
+            self.expect_keyword("as")
+            return ast.Prepare(name, self.parse_statement())
+        if word == "execute":
+            name = self.expect_ident()
+            args: list[ast.Expr] = []
+            if self.accept_op("("):
+                if not self.accept_op(")"):
+                    while True:
+                        args.append(self.parse_expr())
+                        if not self.accept_op(","):
+                            break
+                    self.expect_op(")")
+            return ast.ExecutePrepared(name, tuple(args))
+        name = ("all" if self.cur.kind == "keyword"
+                and self.cur.value == "all" else None)
+        if name:
+            self.advance()
+        else:
+            name = self.expect_ident()
+        return ast.Deallocate(name)
 
     def parse_transaction(self) -> ast.TransactionStmt:
         if self.accept_keyword("begin"):
@@ -432,6 +461,9 @@ class Parser:
 
     def parse_primary(self) -> ast.Expr:
         tok = self.cur
+        if tok.kind == "param":
+            self.advance()
+            return ast.Param(int(tok.value) - 1)
         if tok.kind == "number":
             self.advance()
             if "." in tok.value or "e" in tok.value or "E" in tok.value:

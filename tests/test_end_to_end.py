@@ -55,6 +55,27 @@ class TestTPCH:
     def test_q9(self, tpch_session, oracle_conn):
         check(tpch_session, oracle_conn, tpch.Q9)
 
+    def test_q7(self, tpch_session, oracle_conn):
+        check(tpch_session, oracle_conn, tpch.Q7)
+
+    def test_q8(self, tpch_session, oracle_conn):
+        check(tpch_session, oracle_conn, tpch.Q8)
+
+    def test_q10(self, tpch_session, oracle_conn):
+        check(tpch_session, oracle_conn, tpch.Q10)
+
+    def test_q12(self, tpch_session, oracle_conn):
+        check(tpch_session, oracle_conn, tpch.Q12)
+
+    def test_q14(self, tpch_session, oracle_conn):
+        check(tpch_session, oracle_conn, tpch.Q14)
+
+    def test_q18(self, tpch_session, oracle_conn):
+        check(tpch_session, oracle_conn, tpch.Q18)
+
+    def test_q19(self, tpch_session, oracle_conn):
+        check(tpch_session, oracle_conn, tpch.Q19)
+
 
 class TestQueryShapes:
     """Smaller targeted shapes (multi_schedule-style coverage)."""

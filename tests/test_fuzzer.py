@@ -56,11 +56,16 @@ def test_fuzz_against_oracle(fuzz_env):
     sess, conn = fuzz_env
     n = int(os.environ.get("FUZZ_N", "60"))
     seed = int(os.environ.get("FUZZ_SEED", "20260730"))
-    rng = random.Random(seed)
+    log_path = os.environ.get("FUZZ_LOG")  # crash forensics: last line =
+    rng = random.Random(seed)              # the query that was executing
     planning_rejects = 0
     for i in range(n):
         q = generate(rng)
         sql = q.sql()
+        if log_path:
+            with open(log_path, "a") as f:
+                f.write(f"{i}\t{sql}\n")
+                f.flush()
         try:
             mismatch = _run_both(sess, conn, q)
         except Exception as e:  # engine crash — shrink it too
@@ -81,8 +86,9 @@ def test_fuzz_against_oracle(fuzz_env):
             f"shrunk:   {small.sql()}\n"
             f"mismatch: {mismatch}")
     # sanity: the generator must mostly produce supported queries
+    sanity_rng = random.Random(seed + 1)
     for _ in range(50):
-        q = generate(random.Random(seed + 1))
+        q = generate(sanity_rng)
         try:
             sess.execute(q.sql())
         except PlanningError:

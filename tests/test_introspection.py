@@ -1,0 +1,65 @@
+"""citus_tables / citus_shards introspection UDFs + external-mesh hook
+(the reference's monitoring views, SURVEY §1.1)."""
+
+import jax
+import pytest
+
+import citus_tpu
+from citus_tpu.errors import CatalogError
+
+
+@pytest.fixture()
+def sess(tmp_path):
+    s = citus_tpu.connect(data_dir=str(tmp_path / "d"), n_devices=4,
+                          compute_dtype="float64")
+    s.execute("create table d (k bigint, v bigint)")
+    s.create_distributed_table("d", "k", shard_count=4)
+    s.execute("create table r (id bigint)")
+    s.create_reference_table("r")
+    s.execute("insert into d values (1,2),(3,4),(5,6)")
+    yield s
+    s.close()
+
+
+def test_citus_tables(sess):
+    r = sess.execute("select citus_tables()")
+    by_name = {row[0]: row for row in r.rows()}
+    assert by_name["d"][1] == "hash"
+    assert by_name["d"][2] == "k"
+    assert by_name["d"][4] == 4        # shard_count
+    assert by_name["d"][5] > 0         # bytes on disk
+    assert by_name["r"][1] == "reference"
+
+
+def test_citus_shards(sess):
+    r = sess.execute("select citus_shards('d')")
+    assert r.row_count == 4
+    assert sum(row[6] for row in r.rows()) == 3  # live rows
+    # token ranges tile the hash space
+    mins = sorted(row[2] for row in r.rows())
+    assert mins[0] == -(1 << 31)
+    # all-tables form includes the reference table too
+    r = sess.execute("select citus_shards()")
+    assert {row[0] for row in r.rows()} == {"d", "r"}
+
+
+def test_external_mesh(tmp_path):
+    from citus_tpu.distributed.mesh import SHARD_AXIS
+
+    devs = jax.devices()[:2]
+    import numpy as np
+
+    mesh = jax.sharding.Mesh(np.array(devs), (SHARD_AXIS,))
+    s = citus_tpu.connect(data_dir=str(tmp_path / "m"), mesh=mesh,
+                          compute_dtype="float64")
+    try:
+        assert s.n_devices == 2
+        s.execute("create table t (k bigint)")
+        s.create_distributed_table("t", "k", shard_count=2)
+        s.execute("insert into t values (1),(2),(3)")
+        assert s.execute("select count(*) from t").rows()[0][0] == 3
+    finally:
+        s.close()
+    bad = jax.sharding.Mesh(np.array(devs).reshape(2, 1), ("a", "b"))
+    with pytest.raises(CatalogError, match="single axis"):
+        citus_tpu.connect(data_dir=str(tmp_path / "m2"), mesh=bad)

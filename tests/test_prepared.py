@@ -1,0 +1,142 @@
+"""PREPARE / EXECUTE / DEALLOCATE — generic parameterized plans.
+
+The reference caches distributed plans for prepared statements
+(planner/local_plan_cache.c; deferred param pruning in
+citus_custom_scan.c:213 CitusBeginScan).  Here a SELECT's parameters bind
+as BParam program INPUTS, so one compiled mesh executable serves every
+EXECUTE; capacity growth may recompile a bounded number of times until
+the memoized sizes converge, then hits are guaranteed."""
+
+import sqlite3
+
+import pytest
+
+import citus_tpu
+from citus_tpu.errors import PlanningError
+
+
+@pytest.fixture(scope="module")
+def sess(tmp_path_factory):
+    s = citus_tpu.connect(data_dir=str(tmp_path_factory.mktemp("prep")),
+                          n_devices=4, compute_dtype="float64")
+    s.execute("create table t (k bigint, grp bigint, v double precision, "
+              "d date, name text)")
+    s.create_distributed_table("t", "k", shard_count=8)
+    rows = [(i, i % 13, i * 0.5, f"1995-{i % 12 + 1:02d}-15",
+             f"n{i % 5}") for i in range(6000)]
+    s.execute("insert into t values " + ",".join(
+        f"({k},{g},{v},date '{d}','{n}')" for k, g, v, d, n in rows))
+    con = sqlite3.connect(":memory:")
+    con.execute("create table t (k, grp, v, d, name)")
+    con.executemany("insert into t values (?,?,?,?,?)", rows)
+    yield s, con
+    s.close()
+
+
+def _check(s, con, exec_sql, oracle_sql, args=()):
+    got = sorted(tuple(float(x) if isinstance(x, float) else x
+                       for x in r) for r in s.execute(exec_sql).rows())
+    want = sorted(con.execute(oracle_sql, args).fetchall())
+    assert len(got) == len(want), (exec_sql, got[:3], want[:3])
+    for g, w in zip(got, want):
+        for a, b in zip(g, w):
+            if isinstance(a, float) or isinstance(b, float):
+                assert abs(float(a) - float(b)) <= 1e-6 * max(
+                    1.0, abs(float(b))), (exec_sql, g, w)
+            else:
+                assert a == b, (exec_sql, g, w)
+
+
+class TestPreparedSelect:
+    def test_generic_plan_reuse(self, sess):
+        s, con = sess
+        s.execute("prepare agg as "
+                  "select grp, count(*), sum(v) from t where v > $1 "
+                  "group by grp")
+        _check(s, con, "execute agg(700)",
+               "select grp, count(*), sum(v) from t where v > 700 "
+               "group by grp")
+        pc = s.executor.plan_cache
+        # drive a spread of values until capacities converge...
+        for x in (100, 900, 1500, 2500):
+            s.execute(f"execute agg({x})")
+        converged = pc.misses
+        # ...then repeats and new values of similar shape must all HIT
+        for x in (250, 1250, 2000, 333, 100, 900):
+            _check(s, con, f"execute agg({x})",
+                   "select grp, count(*), sum(v) from t where v > ? "
+                   "group by grp", (x,))
+        assert pc.misses == converged, \
+            "generic plan recompiled after capacity convergence"
+
+    def test_param_types(self, sess):
+        s, con = sess
+        s.execute("prepare dd as select count(*) from t "
+                  "where d >= $1 and grp = $2")
+        _check(s, con, "execute dd(date '1995-06-15', 3)",
+               "select count(*) from t where d >= '1995-06-15' "
+               "and grp = 3")
+
+    def test_string_param_baked(self, sess):
+        # string params bind as constants (documented v1 limit) but must
+        # still answer correctly
+        s, con = sess
+        s.execute("prepare nm as select count(*) from t where name = $1")
+        _check(s, con, "execute nm('n2')",
+               "select count(*) from t where name = 'n2'")
+        _check(s, con, "execute nm('n4')",
+               "select count(*) from t where name = 'n4'")
+
+    def test_fast_path_param_point_lookup(self, sess):
+        s, _ = sess
+        s.execute("prepare pt as select v from t where k = $1")
+        r = s.execute("execute pt(17)")
+        assert r.rows() == [(8.5,)]
+        assert r.fast_path, "dist-col param should route host-side"
+        r = s.execute("execute pt(4242)")
+        assert r.rows() == [(2121.0,)]
+
+    def test_param_in_select_and_topk(self, sess):
+        s, con = sess
+        s.execute("prepare sc as select k, v * $1 as sv from t "
+                  "where v > $2 order by sv desc limit 5")
+        _check(s, con, "execute sc(2, 2900)",
+               "select k, v * 2 as sv from t where v > 2900 "
+               "order by sv desc limit 5")
+
+
+class TestPreparedLifecycle:
+    def test_unknown_and_deallocate(self, sess):
+        s, _ = sess
+        with pytest.raises(PlanningError, match="does not exist"):
+            s.execute("execute nosuch(1)")
+        s.execute("prepare gone as select count(*) from t")
+        s.execute("deallocate gone")
+        with pytest.raises(PlanningError, match="does not exist"):
+            s.execute("execute gone")
+        s.execute("prepare a1 as select count(*) from t")
+        s.execute("prepare a2 as select count(*) from t")
+        s.execute("deallocate all")
+        with pytest.raises(PlanningError, match="does not exist"):
+            s.execute("execute a1")
+
+    def test_missing_argument(self, sess):
+        s, _ = sess
+        s.execute("prepare needs2 as select count(*) from t "
+                  "where v > $1 and grp = $2")
+        with pytest.raises(PlanningError, match="no value"):
+            s.execute("execute needs2(5)")
+
+    def test_prepared_dml(self, sess):
+        s, con = sess
+        s.execute("prepare ins as insert into t values "
+                  "($1, $2, $3, date '1996-01-01', 'px')")
+        s.execute("execute ins(90001, 1, 7.25)")
+        s.execute("execute ins(90002, 2, 8.25)")
+        r = s.execute("select k, v from t where k > 90000 order by k")
+        assert [tuple(x) for x in r.rows()] == [(90001, 7.25),
+                                               (90002, 8.25)]
+        s.execute("prepare del as delete from t where k = $1")
+        s.execute("execute del(90001); execute del(90002)")
+        r = s.execute("select count(*) from t where k > 90000")
+        assert r.rows()[0][0] == 0
