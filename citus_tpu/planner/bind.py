@@ -747,7 +747,15 @@ def _strict_rels(e: ir.BExpr) -> frozenset[int]:
             for p in parts[1:]:
                 out &= p
             return out
-        return parts[0]  # NOT
+        # NOT: strictness of the child only says "not TRUE" (could be
+        # FALSE), and NOT FALSE is TRUE — so NOT preserves strictness
+        # only over children that are themselves NULL-PROPAGATING
+        # (a NULL input makes the child NULL, and NOT NULL is NULL):
+        # direct comparisons / IN.  NOT(AND/OR/...) is never strict.
+        child = e.args[0]
+        if isinstance(child, (ir.BCmp, ir.BInConst)):
+            return _strict_rels(child)
+        return frozenset()
     return frozenset()
 
 
@@ -786,12 +794,17 @@ def _reduce_outer_joins(conjuncts, outer_joins, nullable):
                 if hit_r and hit_t:
                     reduce_now, new_type = True, "inner"
                 elif hit_r:
-                    specs[i] = OuterJoinSpec("left", spec.tree_rels,
+                    # strict on the RIGHT rel kills the tree-preserved
+                    # rows (their right columns are the NULLs) — only
+                    # right-preservation survives
+                    specs[i] = OuterJoinSpec("right", spec.tree_rels,
                                              spec.right_rel_index, spec.on)
                     changed = True
                     continue
                 elif hit_t:
-                    specs[i] = OuterJoinSpec("right", spec.tree_rels,
+                    # symmetric: strict on the tree side kills the
+                    # right-preserved rows — tree-preservation survives
+                    specs[i] = OuterJoinSpec("left", spec.tree_rels,
                                              spec.right_rel_index, spec.on)
                     changed = True
                     continue

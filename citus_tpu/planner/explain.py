@@ -44,6 +44,12 @@ def format_plan(plan: QueryPlan, catalog: Catalog,
         lines.append("  " + "  ".join(combine))
     if plan.device_topk is not None:
         lines.append(f"  Device TopK: {plan.device_topk} rows/device")
+    from ..executor.compiler import collect_device_params
+
+    n_params = len(collect_device_params(plan))
+    if n_params:
+        lines.append(f"  Generic Plan: {n_params} parameter(s) as "
+                     "program inputs")
     from ..executor.fastpath import fast_path_shape
 
     enabled = (settings is None

@@ -289,6 +289,9 @@ class Session:
         if isinstance(stmt, ast.TransactionStmt):
             return self._execute_transaction_stmt(stmt)
         if isinstance(stmt, ast.Prepare):
+            if stmt.name in self._prepared:  # PG raises here too
+                raise PlanningError(
+                    f"prepared statement {stmt.name!r} already exists")
             self._prepared[stmt.name] = stmt.statement
             return None
         if isinstance(stmt, ast.ExecutePrepared):
@@ -867,9 +870,22 @@ class Session:
     def _execute_explain(self, stmt: ast.Explain):
         from .executor.runner import ResultSet
 
-        if not isinstance(stmt.statement, ast.Select):
+        target = stmt.statement
+        params: tuple = ()
+        if isinstance(target, ast.ExecutePrepared):
+            # EXPLAIN EXECUTE name(args): show the generic plan
+            prepared = self._prepared.get(target.name)
+            if prepared is None:
+                raise PlanningError(
+                    f"prepared statement {target.name!r} does not exist")
+            if not isinstance(prepared, ast.Select):
+                raise UnsupportedQueryError(
+                    "EXPLAIN EXECUTE supports prepared SELECTs only")
+            params = target.args
+            target = prepared
+        if not isinstance(target, ast.Select):
             raise UnsupportedQueryError("EXPLAIN supports SELECT only")
-        plan, cleanup = self._plan_select(stmt.statement)
+        plan, cleanup = self._plan_select(target, params)
         try:
             lines = format_plan(plan, self.catalog, self.settings)
             if stmt.analyze:
@@ -893,6 +909,9 @@ class Session:
                 if result.device_rows_scanned:
                     lines.append("Device Rows Scanned: "
                                  f"{result.device_rows_scanned}")
+                if result.streamed_batches:
+                    lines.append("Streamed Execution: "
+                                 f"{result.streamed_batches} batches")
             return ResultSet(["QUERY PLAN"], {"QUERY PLAN": lines},
                              len(lines))
         finally:

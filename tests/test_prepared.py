@@ -105,6 +105,19 @@ class TestPreparedSelect:
                "order by sv desc limit 5")
 
 
+class TestExplainExecute:
+    def test_explain_execute_shows_generic_plan(self, sess):
+        s, _ = sess
+        s.execute("prepare ee as select count(*) from t where v > $1")
+        r = s.execute("explain execute ee(100)")
+        text = "\n".join(str(row[0]) for row in r.rows())
+        assert "Generic Plan: 1 parameter" in text
+        assert "$1" in text  # the filter renders the param symbolically
+        r = s.execute("explain analyze execute ee(100)")
+        text = "\n".join(str(row[0]) for row in r.rows())
+        assert "Execution Time" in text
+
+
 class TestPreparedLifecycle:
     def test_unknown_and_deallocate(self, sess):
         s, _ = sess

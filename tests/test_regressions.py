@@ -347,3 +347,55 @@ def test_params_inside_subqueries(sess):
               "select count(*) from pa join big on k = k2")
     assert s.execute("execute csub(1)").rows()[0][0] == 10
     assert s.execute("execute csub(2)").rows()[0][0] == 5
+
+
+def test_full_join_one_sided_reduction_direction(sess):
+    """Review-found: strict WHERE on the RIGHT side of a FULL join must
+    keep RIGHT-preservation (dropping only tree-preserved rows), not the
+    other way around."""
+    s = sess
+    s.execute("create table fa (k bigint, av bigint)")
+    s.create_distributed_table("fa", "k", shard_count=4)
+    s.execute("create table fb (k2 bigint, bv bigint)")
+    s.create_distributed_table("fb", "k2", shard_count=4)
+    s.execute("insert into fa values (1, 100), (2, 200)")
+    s.execute("insert into fb values (1, 10), (5, 50)")
+    r = s.execute("select k, bv from fa full join fb on k = k2 "
+                  "where bv > 0 order by bv")
+    assert [tuple(x) for x in r.rows()] == [(1, 10), (None, 50)]
+    # symmetric: strict on the tree side keeps tree-preservation
+    r = s.execute("select k, bv from fa full join fb on k = k2 "
+                  "where av > 0 order by av")
+    assert [tuple(x) for x in r.rows()] == [(1, 10), (2, None)]
+
+
+def test_not_over_and_does_not_reduce_outer_join(sess):
+    """Review-found: NOT(a AND b) can be TRUE for a null-extended row
+    (NOT(NULL AND FALSE) = TRUE), so it must not count as strict."""
+    s = sess
+    s.execute("create table na (k bigint, av bigint)")
+    s.create_distributed_table("na", "k", shard_count=4)
+    s.execute("create table nb (k2 bigint, bv bigint)")
+    s.create_distributed_table("nb", "k2", shard_count=4)
+    s.execute("insert into na values (1, 100), (2, 200)")
+    s.execute("insert into nb values (1, 10)")
+    r = s.execute("select k, bv from na left join nb on k = k2 "
+                  "where not (bv = 10 and av = 999) order by k")
+    assert [tuple(x) for x in r.rows()] == [(1, 10), (2, None)]
+    # NOT over a bare comparison IS strict (NULL comparison stays NULL)
+    r = s.execute("select k, bv from na left join nb on k = k2 "
+                  "where not (bv = 99) order by k")
+    assert [tuple(x) for x in r.rows()] == [(1, 10)]
+
+
+def test_prepare_duplicate_name_rejected(sess):
+    from citus_tpu.errors import PlanningError
+
+    s = sess
+    s.execute("create table pp (k bigint)")
+    s.create_distributed_table("pp", "k", shard_count=4)
+    s.execute("prepare dup1 as select count(*) from pp")
+    with pytest.raises(PlanningError, match="already exists"):
+        s.execute("prepare dup1 as select k from pp")
+    s.execute("deallocate dup1")
+    s.execute("prepare dup1 as select k from pp")  # freed name reusable
