@@ -1065,6 +1065,38 @@ class PlanCompiler:
         values = self._agg_values(node, blk)
         return key_arrays, key_meta, values
 
+    @staticmethod
+    def agg_pushdown_shape(node: AggregateNode) -> bool:
+        """Static mirror of _try_join_agg_pushdown's eligibility: True ⇒
+        the pushdown will handle this aggregate WITHOUT pair emission, so
+        capacity planning must not charge the join-output buffer (at
+        scale that phantom buffer can alone trip the plan-size guard)."""
+        from ..planner import expr as ir
+
+        if node.combine != "global" or node.group_keys:
+            return False
+        j = node.input
+        if not isinstance(j, JoinNode) or j.join_type != "inner" or \
+                j.residual is not None:
+            return False
+        if j.dist.kind == "replicated":
+            return False
+        lcids = set(j.left.out_columns)
+        rcids = set(j.right.out_columns)
+        agg_side = None
+        for a, _cid in node.aggs:
+            if a.kind == "count_star":
+                continue
+            if a.kind not in ("count", "sum", "min", "max"):
+                return False
+            cids = {c.cid for c in ir.walk(a.arg) if isinstance(c, ir.BCol)}
+            side = ("left" if cids <= lcids
+                    else "right" if cids <= rcids else None)
+            if side is None or (agg_side is not None and side != agg_side):
+                return False
+            agg_side = side
+        return True
+
     def _try_join_agg_pushdown(self, node: AggregateNode, feeds):
         """Global aggregate over an inner join WITHOUT pair emission.
 
@@ -1074,32 +1106,21 @@ class PlanCompiler:
         retries) disappear entirely — the analogue of the reference
         pushing count/sum into worker queries instead of shipping join
         rows (planner/multi_logical_optimizer.c WorkerExtendedOpNode).
-        Returns None when the shape doesn't qualify."""
+        Returns None when the shape doesn't qualify (eligibility mirrors
+        agg_pushdown_shape, which capacity planning consults)."""
         from ..planner import expr as ir
         from ..ops.join import _bounds
 
-        if node.combine != "global" or node.group_keys:
+        if not self.agg_pushdown_shape(node):
             return None
         j = node.input
-        if not isinstance(j, JoinNode) or j.join_type != "inner" or \
-                j.residual is not None:
-            return None
-        if j.dist.kind == "replicated":
-            return None  # both sides replicated: psum would double-count
         lcids = set(j.left.out_columns)
-        rcids = set(j.right.out_columns)
         agg_side = None
         for a, _cid in node.aggs:
             if a.kind == "count_star":
                 continue
-            if a.kind not in ("count", "sum", "min", "max"):
-                return None
             cids = {c.cid for c in ir.walk(a.arg) if isinstance(c, ir.BCol)}
-            side = ("left" if cids <= lcids
-                    else "right" if cids <= rcids else None)
-            if side is None or (agg_side is not None and side != agg_side):
-                return None
-            agg_side = side
+            agg_side = "left" if cids <= lcids else "right"
         if agg_side is None:
             # count(*) only: probe whichever side the planner made probe
             agg_side = ("left" if getattr(j, "build_side", "right")

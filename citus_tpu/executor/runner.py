@@ -254,7 +254,10 @@ class Executor:
         agg_out: dict[int, int] = {}
         scan_out: dict[int, int] = {}
 
-        def cap_of(node) -> int:
+        def cap_of(node, skip_emit: bool = False) -> int:
+            """skip_emit: the node's OWN output buffer is never
+            allocated (aggregate pushdown consumes the join without pair
+            emission) — register child + repartition capacities only."""
             if isinstance(node, ScanNode):
                 base = feeds[id(node)].capacity
                 if node.filter is None:
@@ -288,6 +291,8 @@ class Executor:
                         int(max(lcap, rcap) * repart_factor))
                     lcap = n_dev * repart[id(node)]
                     rcap = n_dev * repart[id(node)]
+                if skip_emit:
+                    return max(lcap, rcap)  # no emission buffer exists
                 if getattr(node, "fuse_lookup", False) and not dense_off \
                         and node.left_keys:
                     # fused PK lookup: one output slot per probe row; a
@@ -333,6 +338,11 @@ class Executor:
                         int(in_cap * n_dev * repart_factor))
                 return n_dev * repart[id(node)]
             if isinstance(node, AggregateNode):
+                if node.combine == "global" and \
+                        isinstance(node.input, JoinNode) and \
+                        PlanCompiler.agg_pushdown_shape(node):
+                    cap_of(node.input, skip_emit=True)
+                    return 1
                 in_cap = cap_of(node.input)
                 if node.combine == "global":
                     return 1

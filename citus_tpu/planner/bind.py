@@ -728,9 +728,10 @@ def _strict_rels(e: ir.BExpr) -> frozenset[int]:
     null-propagating referenced column of such a rel makes the predicate
     non-TRUE, so the row cannot survive WHERE/inner-ON filtering.
     Comparisons and IN are strict in the rels their null-propagating
-    operands reference; AND unions, OR intersects, NOT passes through
-    (NOT NULL is NULL); IS NULL and unknown node kinds are never
-    strict."""
+    operands reference; AND unions, OR intersects; NOT is strict only
+    over a bare comparison/IN (strictness of AND/OR children guarantees
+    merely non-TRUE, and NOT FALSE is TRUE); IS NULL and unknown node
+    kinds are never strict."""
     if isinstance(e, ir.BCmp):
         return _null_propagating_rels(e.left) | \
             _null_propagating_rels(e.right)

@@ -672,6 +672,13 @@ class DistributedPlanner:
             # left replicated, right sharded: join runs devicewise against
             # right's shards; result inherits right's distribution
             return "broadcast_left"
+        if self.n_devices == 1:
+            # a 1-device mesh holds every shard on the same chip: any
+            # keyed join is trivially co-located; all_to_all there would
+            # be an identity shuffle paying full pack/unpack buffers
+            # (the single-node local-join behavior of the reference's
+            # local executor, executor/local_executor.c:163)
+            return "local"
         # per-edge alignment with each side's partition columns: a join can
         # run locally / with a single repartition only through ONE edge
         # whose key matches the partition column (multi-edge joins like
