@@ -44,6 +44,8 @@ class ChangeLog:
         self.enabled = enabled
         self._mu = threading.Lock()
         self._tls = threading.local()
+        self._tail_checked = False
+        self.torn_lines = 0
         self._next_lsn = self._scan_next_lsn()
 
     def _scan_next_lsn(self) -> int:
@@ -111,8 +113,21 @@ class ChangeLog:
                 ev["ts"] = now
                 self._next_lsn += 1
                 payload.append(json.dumps(ev))
+            lead = ""
+            if not self._tail_checked:
+                # a crash may have torn the last line mid-append; isolate
+                # the partial tail so this commit's first event stays
+                # parseable instead of concatenating onto the garbage
+                self._tail_checked = True
+                try:
+                    with open(self.path, "rb") as rf:
+                        rf.seek(-1, os.SEEK_END)
+                        if rf.read(1) != b"\n":
+                            lead = "\n"
+                except OSError:
+                    pass  # no file / empty file: nothing to isolate
             with open(self.path, "a") as f:
-                f.write("\n".join(payload) + "\n")
+                f.write(lead + "\n".join(payload) + "\n")
                 f.flush()
                 os.fsync(f.fileno())
 
@@ -128,7 +143,14 @@ class ChangeLog:
             for line in f:
                 if not line.strip():
                     continue
-                ev = json.loads(line)
+                try:
+                    ev = json.loads(line)
+                except ValueError:
+                    # torn line from a crash mid-append (same tolerance
+                    # as _scan_next_lsn); later appends are isolated by
+                    # emit()'s tail check, so just skip it
+                    self.torn_lines += 1
+                    continue
                 if ev["lsn"] <= from_lsn:
                     continue
                 if table is not None and ev["table"] != table:

@@ -63,7 +63,7 @@ def node_fingerprint(node: PlanNode) -> str:
         return (f"J({node.strategy};{node.join_type};{node.repart_key_idx};"
                 f"{node.build_side};{node.left_key_extents};"
                 f"{node.right_key_extents};{node.key_int32};"
-                f"{node.fuse_lookup};"
+                f"{node.fuse_lookup};{node.flag_combine};"
                 f"{node_fingerprint(node.left)};"
                 f"{node_fingerprint(node.right)};"
                 f"{[repr(k) for k in node.left_keys]};"

@@ -819,7 +819,9 @@ class PlanCompiler:
         lblk = self._exec(node.left, feeds)
         rblk = self._exec(node.right, feeds)
 
-        keep_l = node.join_type in ("left", "full")   # probe side preserved
+        # probe side preserved: left/full null-extend; anti KEEPS null-key
+        # probe rows (they match nothing, so NOT EXISTS holds for them)
+        keep_l = node.join_type in ("left", "full", "anti")
         keep_r = node.join_type in ("right", "full")  # build side preserved
         if node.strategy in ("local", "broadcast"):
             pass
@@ -923,6 +925,9 @@ class PlanCompiler:
     def _exec_join(self, node: JoinNode, feeds) -> Block:
         lblk, rblk, lkeys, lmatch, rkeys, rmatch = \
             self._join_inputs(node, feeds)
+        if node.join_type in ("semi", "anti"):
+            return self._exec_semi_join(node, lblk, rblk, lkeys, lmatch,
+                                        rkeys, rmatch)
         if getattr(node, "fuse_lookup", False) and not self.caps.dense_off:
             blk = self._exec_lookup_join(node, lblk, rblk, lkeys, lmatch,
                                          rkeys, rmatch)
@@ -966,6 +971,67 @@ class PlanCompiler:
             blk = blk.with_filter(predicate_mask(node.residual,
                                                  _src(blk), jnp))
         return blk
+
+    def _exec_semi_join(self, node: JoinNode, lblk: Block, rblk: Block,
+                        lkeys, lmatch, rkeys, rmatch) -> Block:
+        """Semi/anti join (decorrelated EXISTS / NOT EXISTS).
+
+        Output rows ARE probe rows — no pair expansion, no emission
+        buffer: without a residual this is one directory/binary-search
+        bounds pass producing per-probe match counts (cheaper than any
+        pair-emitting join).  With a cross-side residual (Q21's
+        `l2.l_suppkey <> l1.l_suppkey`), candidate pairs expand, the
+        residual evaluates per pair, and a scatter-max ORs survivors
+        back onto probe rows.  With `flag_combine` (probe replicated
+        over a sharded build) the per-device flags psum across the mesh.
+        Reference semantics: semi/anti join rewrites in
+        planner/recursive_planning.c:223."""
+        from ..ops.join import _bounds
+
+        dense = self._dense_for(getattr(node, "right_key_extents", ()),
+                                rkeys)
+        n = lkeys[0].shape[0] if lkeys else lblk.valid.shape[0]
+        if node.residual is None:
+            order, lo, hi, dense_oob = _bounds(rkeys, rmatch, lkeys, dense)
+            self._dense_oob = self._dense_oob + dense_oob.astype(jnp.int64)
+            matched = lmatch & (hi > lo)
+        else:
+            from ..planner.expr import expr_columns
+
+            cap = self.caps.join_out[id(node)]
+            bidx, pidx, out_valid, _miss, overflow, dense_oob = \
+                expand_join_pairs(rkeys, rmatch, lkeys, lmatch, lmatch,
+                                  cap, probe_outer=False, dense=dense)
+            self._overflow = self._overflow + overflow.astype(jnp.int64)
+            self._dense_oob = self._dense_oob + dense_oob.astype(jnp.int64)
+            # gather ONLY the residual's columns at pair capacity — the
+            # output block is the probe block, so everything else would
+            # be wasted HBM traffic on the widest intermediate
+            need = expr_columns(node.residual)
+            cols, nulls = {}, {}
+            for cid in need:
+                if cid in lblk.columns:
+                    cols[cid] = lblk.columns[cid][pidx]
+                    nm = lblk.nulls.get(cid)
+                    if nm is not None:
+                        nulls[cid] = nm[pidx]
+                elif cid in rblk.columns:
+                    cols[cid] = rblk.columns[cid][bidx]
+                    nm = rblk.nulls.get(cid)
+                    if nm is not None:
+                        nulls[cid] = nm[bidx]
+            pair = Block(cols, out_valid, nulls)
+            ok = out_valid & predicate_mask(node.residual, _src(pair), jnp)
+            matched = (jnp.zeros(n, jnp.int32)
+                       .at[pidx].max(ok.astype(jnp.int32))) > 0
+        if getattr(node, "flag_combine", False):
+            matched = jax.lax.psum(matched.astype(jnp.int32),
+                                   SHARD_AXIS) > 0
+        if node.join_type == "anti":
+            valid = lblk.valid & ~matched
+        else:
+            valid = lblk.valid & matched
+        return Block(dict(lblk.columns), valid, dict(lblk.nulls))
 
     def _exec_outer_expand(self, node: JoinNode, lblk: Block, rblk: Block,
                            lkeys, lmatch, rkeys, rmatch,

@@ -187,6 +187,19 @@ def evaluate(e: ir.BExpr, src: ColumnSource, xp):
             old_null = (xp.zeros((), dtype=bool) if nmask is None else nmask)
             nmask = xp.where(take, new_null, old_null)
         return out, nmask
+    if isinstance(e, ir.BStrRemap):
+        v, nmask = evaluate(e.operand, src, xp)
+        m = len(e.lut)
+        if m == 0:
+            # empty dictionary (all-NULL / empty column): codes are all
+            # NULL_CODE — pass them through, nothing to remap
+            return v, nmask
+        lut = xp.asarray(list(e.lut), dtype=np.int32)
+        # codes outside [0, m) are NULL_CODE or post-bind interned values
+        # (stale plan — the fingerprint includes the lut, but guard the
+        # gather anyway); map them to themselves → treated as NULL below
+        safe = xp.clip(v, 0, m - 1)
+        return xp.where((v >= 0) & (v < m), lut[safe], v), nmask
     if isinstance(e, ir.BCast):
         v, nmask = evaluate(e.operand, src, xp)
         return v.astype(_dt(e.dtype, xp)), nmask

@@ -128,7 +128,9 @@ def _target_arrays(session, meta, columns, result):
                         f"cannot infer dictionary for string column "
                         f"{tgt_col!r}")
             else:
-                src_d = session.store.dictionary(*src)
+                from ..storage.dictionary import resolve_decode
+
+                src_d = resolve_decode(session.store, src)
                 tgt_d = session.store.dictionary(meta.name, tgt_col)
                 if src == (meta.name, tgt_col):
                     codes = arr.astype(np.int32)

@@ -28,6 +28,7 @@ from ..planner.plan import (
     WindowNode,
 )
 from ..storage import TableStore
+from ..storage.dictionary import resolve_decode
 from ..types import DataType, days_to_date
 from .cache import (
     FeedCache,
@@ -291,6 +292,15 @@ class Executor:
                         int(max(lcap, rcap) * repart_factor))
                     lcap = n_dev * repart[id(node)]
                     rcap = n_dev * repart[id(node)]
+                if node.join_type in ("semi", "anti"):
+                    # output rows ARE probe rows (no emission buffer);
+                    # only a cross-side residual needs a candidate-pair
+                    # expansion buffer
+                    if node.residual is not None:
+                        join_out[id(node)] = _round_cap(int(
+                            lcap * join_factor
+                            * max(1.0, node.est_expansion)) + 128)
+                    return lcap
                 if skip_emit:
                     return max(lcap, rcap)  # no emission buffer exists
                 if getattr(node, "fuse_lookup", False) and not dense_off \
@@ -417,8 +427,7 @@ class Executor:
                 if isinstance(e, ir.BCol) and e.cid in plan.decode:
                     decode_map[out_name] = plan.decode[e.cid]
             elif isinstance(e, ir.BCol) and e.cid in plan.decode:
-                table, column = plan.decode[e.cid]
-                d = self.store.dictionary(table, column)
+                d = resolve_decode(self.store, plan.decode[e.cid])
                 out_cols[out_name] = _decode_strings(d, v, nmask)
             elif e.dtype == DataType.DATE:
                 out_cols[out_name] = _format_dates(v, nmask)
@@ -436,8 +445,7 @@ class Executor:
                 nmask = (np.zeros(n, dtype=bool) if nmask is None
                          else np.broadcast_to(np.asarray(nmask), (n,)))
                 if isinstance(e, ir.BCol) and e.cid in plan.decode:
-                    table, column = plan.decode[e.cid]
-                    d = self.store.dictionary(table, column)
+                    d = resolve_decode(self.store, plan.decode[e.cid])
                     lut = np.asarray(d.values + [""], dtype=object)
                     codes = np.asarray(v).astype(np.int64)
                     oob = (codes < 0) | (codes >= len(d))

@@ -117,7 +117,9 @@ def _stream_path(plan: QueryPlan, stream_id: int) -> bool:
             on_left = path[i + 1] is node.left
             if node.join_type == "inner":
                 continue
-            if node.join_type == "left" and on_left:
+            if node.join_type in ("left", "semi", "anti") and on_left:
+                # semi/anti distribute over probe batches when the build
+                # side is fully resident (each batch sees every match)
                 continue
             if node.join_type == "right" and not on_left:
                 continue

@@ -490,6 +490,117 @@ where p_partkey = l_partkey
         and l_shipinstruct = 'DELIVER IN PERSON'))
 """
 
-QUERIES = {"Q1": Q1, "Q3": Q3, "Q5": Q5, "Q6": Q6, "Q7": Q7, "Q8": Q8,
-           "Q9": Q9, "Q10": Q10, "Q12": Q12, "Q14": Q14, "Q18": Q18,
-           "Q19": Q19}
+# -- correlated-subquery queries (decorrelated into semi/anti joins and
+#    grouped derived tables by planner/decorrelate.py) ----------------------
+
+Q2 = """
+select s_acctbal, s_name, n_name, p_partkey, p_mfgr, s_address,
+       s_phone, s_comment
+from part, supplier, partsupp, nation, region
+where p_partkey = ps_partkey
+  and s_suppkey = ps_suppkey
+  and p_size = 15
+  and p_type like '%BRASS'
+  and s_nationkey = n_nationkey
+  and n_regionkey = r_regionkey
+  and r_name = 'EUROPE'
+  and ps_supplycost = (
+      select min(ps_supplycost)
+      from partsupp, supplier, nation, region
+      where p_partkey = ps_partkey
+        and s_suppkey = ps_suppkey
+        and s_nationkey = n_nationkey
+        and n_regionkey = r_regionkey
+        and r_name = 'EUROPE')
+order by s_acctbal desc, n_name, s_name, p_partkey
+limit 100
+"""
+
+Q4 = """
+select o_orderpriority, count(*) as order_count
+from orders
+where o_orderdate >= date '1993-07-01'
+  and o_orderdate < date '1993-07-01' + interval '3' month
+  and exists (
+      select 1 from lineitem
+      where l_orderkey = o_orderkey
+        and l_commitdate < l_receiptdate)
+group by o_orderpriority
+order by o_orderpriority
+"""
+
+Q17 = """
+select sum(l_extendedprice) / 7.0 as avg_yearly
+from lineitem, part
+where p_partkey = l_partkey
+  and p_brand = 'Brand#23'
+  and p_container = 'MED BOX'
+  and l_quantity < (
+      select 0.2 * avg(l_quantity)
+      from lineitem
+      where l_partkey = p_partkey)
+"""
+
+Q20 = """
+select s_name, s_address
+from supplier, nation
+where s_suppkey in (
+      select ps_suppkey
+      from partsupp
+      where ps_partkey in (select p_partkey from part
+                           where p_name like 'forest%')
+        and ps_availqty > (
+            select 0.5 * sum(l_quantity)
+            from lineitem
+            where l_partkey = ps_partkey
+              and l_suppkey = ps_suppkey
+              and l_shipdate >= date '1994-01-01'
+              and l_shipdate < date '1994-01-01' + interval '1' year))
+  and s_nationkey = n_nationkey
+  and n_name = 'CANADA'
+order by s_name
+"""
+
+Q21 = """
+select s_name, count(*) as numwait
+from supplier, lineitem l1, orders, nation
+where s_suppkey = l1.l_suppkey
+  and o_orderkey = l1.l_orderkey
+  and o_orderstatus = 'F'
+  and l1.l_receiptdate > l1.l_commitdate
+  and exists (
+      select 1 from lineitem l2
+      where l2.l_orderkey = l1.l_orderkey
+        and l2.l_suppkey <> l1.l_suppkey)
+  and not exists (
+      select 1 from lineitem l3
+      where l3.l_orderkey = l1.l_orderkey
+        and l3.l_suppkey <> l1.l_suppkey
+        and l3.l_receiptdate > l3.l_commitdate)
+  and s_nationkey = n_nationkey
+  and n_name = 'SAUDI ARABIA'
+group by s_name
+order by numwait desc, s_name
+limit 100
+"""
+
+Q22 = """
+select cntrycode, count(*) as numcust, sum(c_acctbal) as totacctbal
+from (select substring(c_phone from 1 for 2) as cntrycode, c_acctbal
+      from customer
+      where substring(c_phone from 1 for 2) in
+            ('13', '31', '23', '29', '30', '18', '17')
+        and c_acctbal > (select avg(c_acctbal) from customer
+                         where c_acctbal > 0.00
+                           and substring(c_phone from 1 for 2) in
+                               ('13', '31', '23', '29', '30', '18', '17'))
+        and not exists (select 1 from orders
+                        where o_custkey = c_custkey)) as custsale
+group by cntrycode
+order by cntrycode
+"""
+
+QUERIES = {"Q1": Q1, "Q2": Q2, "Q3": Q3, "Q4": Q4, "Q5": Q5, "Q6": Q6,
+           "Q7": Q7, "Q8": Q8, "Q9": Q9, "Q10": Q10, "Q12": Q12,
+           "Q14": Q14, "Q17": Q17, "Q18": Q18, "Q19": Q19, "Q20": Q20,
+           "Q21": Q21, "Q22": Q22}

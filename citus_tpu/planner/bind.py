@@ -45,12 +45,14 @@ class BoundRel:
 
 @dataclass(frozen=True)
 class OuterJoinSpec:
-    """One LEFT/RIGHT/FULL join step: the accumulated tree of previously
-    bound rels joins one single relation (`right_rel_index`) with its own
-    ON conjuncts (which must NOT merge into WHERE — null extension happens
-    before WHERE filters).  join_type is relative to (tree, right_rel):
-    'left' preserves the tree, 'right' preserves the single rel, 'full'
-    preserves both."""
+    """One LEFT/RIGHT/FULL/SEMI/ANTI join step: the accumulated tree of
+    previously bound rels joins one single relation (`right_rel_index`)
+    with its own ON conjuncts (which must NOT merge into WHERE — null
+    extension happens before WHERE filters).  join_type is relative to
+    (tree, right_rel): 'left' preserves the tree, 'right' preserves the
+    single rel, 'full' preserves both; 'semi'/'anti' (decorrelated
+    EXISTS/NOT EXISTS) filter the tree by match existence and expose no
+    right-side columns."""
 
     join_type: str
     tree_rels: frozenset[int]
@@ -172,6 +174,23 @@ class Binder:
             raise PlanningError("HAVING requires GROUP BY or aggregates")
         if is_aggregate:
             self._check_grouping(select, group_by)
+
+        # decorrelated EXISTS/NOT EXISTS: semi/anti join the whole FROM
+        # tree against each subquery relation (bound AFTER select/where so
+        # its columns are invisible to the rest of the query)
+        for sj in sel.semi_joins:
+            n_before = len(rels)
+            tree = frozenset(range(n_before))
+            if not isinstance(sj.item, ast.TableRef):
+                raise PlanningError(
+                    "semi-join subqueries must be planned recursively "
+                    "before binding")
+            self._bind_from_item(sj.item, rels, conjuncts, outer_joins,
+                                 nullable)
+            on = ir.split_conjuncts(
+                self.bind_expr(sj.condition, _Scope(rels)))
+            outer_joins.append(
+                OuterJoinSpec(sj.join_type, tree, n_before, tuple(on)))
 
         conjuncts, outer_joins, nullable = _reduce_outer_joins(
             conjuncts, outer_joins, nullable)
@@ -357,9 +376,52 @@ class Binder:
             raise PlanningError(
                 "subqueries must be planned recursively before binding")
         if isinstance(e, ast.Substring):
-            raise PlanningError(
-                "SUBSTRING on device columns is not supported yet")
+            return self._bind_substring(e, scope, allow_agg)
         raise PlanningError(f"unsupported expression {type(e).__name__}")
+
+    def _bind_substring(self, e: ast.Substring, scope: "_Scope",
+                        allow_agg: bool) -> ir.BExpr:
+        """SUBSTRING over a dictionary-encoded column → code-remap LUT:
+        the (small) dictionary transforms host-side once; the device does
+        one gather.  No per-row string ops ever reach the device."""
+        operand = self.bind_expr(e.operand, scope, allow_agg)
+        if operand.dtype != DataType.STRING:
+            raise PlanningError("SUBSTRING requires a string operand")
+
+        def int_lit(x, what):
+            if isinstance(x, ast.Literal) and isinstance(x.value, int):
+                return x.value
+            raise PlanningError(f"SUBSTRING {what} must be an integer "
+                                "literal")
+
+        start = int_lit(e.start, "start")
+        length = (int_lit(e.length, "length")
+                  if e.length is not None else None)
+        if start < 1 or (length is not None and length < 0):
+            raise PlanningError("SUBSTRING bounds out of range")
+        lo = start - 1
+        hi = None if length is None else lo + length
+        label = (f"substring({start})" if length is None
+                 else f"substring({start},{length})")
+        return self._bind_strmap(operand, lambda v: v[lo:hi], label)
+
+    def _bind_strmap(self, operand: ir.BExpr, fn, label: str) -> ir.BExpr:
+        values = self._string_values(operand)
+        uniq: dict[str, int] = {}
+        lut = []
+        for v in values:
+            lut.append(uniq.setdefault(fn(v), len(uniq)))
+        if isinstance(operand, ir.BStrRemap):
+            # compose remaps: one gather instead of two
+            lut = [lut[c] for c in operand.lut]
+            operand = operand.operand
+        return ir.BStrRemap(operand, tuple(lut), tuple(uniq), label)
+
+    def _string_values(self, col: ir.BExpr) -> tuple[str, ...]:
+        if isinstance(col, ir.BStrRemap):
+            return col.values
+        d = self._dict_for(col)
+        return tuple(d.values)
 
     def _bind_literal(self, e: ast.Literal) -> ir.BConst:
         if e.type_hint == "date":
@@ -570,11 +632,18 @@ class Binder:
         return self.dicts.dictionary(col.table, col.column)
 
     def _code_of(self, col: ir.BExpr, text: str) -> int:
+        if isinstance(col, ir.BStrRemap):
+            try:
+                return col.values.index(text)
+            except ValueError:
+                return MISSING_CODE
         d = self._dict_for(col)
         code = d.code_of(text)
         return MISSING_CODE if code is None else code
 
     def _codes_where(self, col: ir.BExpr, pred) -> tuple[int, ...]:
+        if isinstance(col, ir.BStrRemap):
+            return tuple(i for i, v in enumerate(col.values) if pred(v))
         d = self._dict_for(col)
         return tuple(i for i, v in enumerate(d.values) if pred(v))
 
@@ -782,6 +851,8 @@ def _reduce_outer_joins(conjuncts, outer_joins, nullable):
         for c in conjuncts:
             strict |= _strict_rels(c)
         for i, spec in enumerate(specs):
+            if spec.join_type in ("semi", "anti"):
+                continue  # no null extension: nothing to reduce
             right = frozenset((spec.right_rel_index,))
             if spec.join_type == "left":
                 reduce_now = bool(strict & right)

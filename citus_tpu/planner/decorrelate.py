@@ -1,0 +1,437 @@
+"""Correlated-subquery decorrelation (AST → AST rewrite).
+
+The reference plans correlated subqueries through recursive planning plus
+local-distributed-join rewrites (recursive_planning.c:223,
+local_distributed_join_planner.c:1-60).  Here the same query shapes are
+decorrelated *before* recursive planning into TPU-friendly set operations:
+
+* correlated EXISTS / NOT EXISTS (WHERE-conjunct level)
+    →  semi / anti join of the outer FROM tree against the subquery's
+       FROM (local predicates stay inside; correlation predicates become
+       the join condition).  The executor's semi join is a probe-side
+       match-flag pass — no pair expansion, cheaper than an inner join.
+* correlated `x IN (SELECT y …)`
+    →  EXISTS with the extra conjunct `y = x`, then the semi-join path.
+* correlated scalar aggregate under a comparison
+    `expr op (SELECT agg(..) FROM inner WHERE inner.k = outer.k AND L)`
+    →  inner join against the grouped derived table
+       `(SELECT k, agg(..) FROM inner WHERE L GROUP BY k)`
+       (classic magic-set / group-then-join decorrelation).  Exact under
+       WHERE-conjunct semantics: an empty group yields NULL on the
+       original form (comparison never TRUE) and a dropped row on the
+       join form.  count(*) is rejected — empty groups there compare
+       against 0, which the join form cannot see.
+
+TPC-H Q2/Q4/Q17/Q20/Q21/Q22 are exactly these shapes.
+
+The rewrite is conservative: anything whose correlation structure falls
+outside these patterns raises UnsupportedQueryError (uncorrelated
+subqueries are untouched — the recursive planner executes them eagerly).
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import replace as dc_replace
+from typing import Callable, Optional
+
+from ..errors import UnsupportedQueryError
+from ..sql import ast
+
+# fresh-alias counter for derived tables (process-wide; aliases only need
+# to be unique within one query, but uniqueness everywhere is harmless)
+_alias_counter = itertools.count()
+
+CMP_OPS = ("=", "<>", "!=", "<", "<=", ">", ">=")
+
+
+def _fresh_alias() -> str:
+    return f"__dt{next(_alias_counter)}"
+
+
+# --------------------------------------------------------------------------
+# scope resolution
+# --------------------------------------------------------------------------
+
+class _Scope:
+    """Alias → known column-name set for one FROM list.  `exact` is False
+    when any relation's columns are unknown (e.g. SELECT * subquery) —
+    unqualified resolution is then unreliable and rewrites bail out."""
+
+    def __init__(self):
+        self.columns: dict[str, frozenset[str] | None] = {}
+        self.exact = True
+
+    def add(self, alias: str, cols: Optional[frozenset[str]]):
+        self.columns[alias] = cols
+        if cols is None:
+            self.exact = False
+
+    def resolves(self, ref: ast.ColumnRef) -> bool:
+        if ref.table is not None:
+            return ref.table in self.columns
+        for cols in self.columns.values():
+            if cols is not None and ref.name in cols:
+                return True
+        return False
+
+
+def _subquery_output_columns(q: ast.Select) -> Optional[frozenset[str]]:
+    out = set()
+    for i, it in enumerate(q.items):
+        if isinstance(it.expr, ast.Star):
+            return None
+        if it.alias:
+            out.add(it.alias)
+        elif isinstance(it.expr, ast.ColumnRef):
+            out.add(it.expr.name)
+        else:
+            out.add(f"col{i}")
+    return frozenset(out)
+
+
+def _build_scope(from_items, columns_of: Callable[[str], Optional[frozenset]],
+                 scope: Optional[_Scope] = None) -> _Scope:
+    scope = scope or _Scope()
+    for fi in from_items:
+        if isinstance(fi, ast.TableRef):
+            scope.add(fi.alias or fi.name, columns_of(fi.name))
+        elif isinstance(fi, ast.SubqueryRef):
+            scope.add(fi.alias, _subquery_output_columns(fi.query))
+        elif isinstance(fi, ast.Join):
+            _build_scope((fi.left, fi.right), columns_of, scope)
+        else:  # unknown FROM item kind: give up on exact resolution
+            scope.exact = False
+    return scope
+
+
+def _select_refs(q: ast.Select):
+    """Every ColumnRef at THIS query level (nested sub-Selects excluded —
+    multi-level correlation is out of scope and surfaces as a binding
+    error in the eager path)."""
+    exprs = [it.expr for it in q.items]
+    if q.where is not None:
+        exprs.append(q.where)
+    exprs.extend(q.group_by)
+    if q.having is not None:
+        exprs.append(q.having)
+    exprs.extend(o.expr for o in q.order_by)
+    for e in exprs:
+        yield from _expr_refs(e)
+
+
+def _expr_refs(e: ast.Expr):
+    if isinstance(e, (ast.ScalarSubquery, ast.Exists)):
+        return
+    if isinstance(e, ast.InSubquery):
+        yield from _expr_refs(e.operand)
+        return
+    if isinstance(e, ast.ColumnRef):
+        yield e
+    for c in ast.expr_children(e):
+        yield from _expr_refs(c)
+
+
+def _is_correlated(sub: ast.Select, inner: _Scope, outer: _Scope) -> bool:
+    return any(not inner.resolves(r) and outer.resolves(r)
+               for r in _select_refs(sub))
+
+
+# --------------------------------------------------------------------------
+# conjunct helpers
+# --------------------------------------------------------------------------
+
+def _split_and(e: Optional[ast.Expr]) -> list[ast.Expr]:
+    if e is None:
+        return []
+    if isinstance(e, ast.BinaryOp) and e.op.upper() == "AND":
+        return _split_and(e.left) + _split_and(e.right)
+    return [e]
+
+
+def _make_and(conjuncts: list[ast.Expr]) -> Optional[ast.Expr]:
+    if not conjuncts:
+        return None
+    out = conjuncts[0]
+    for c in conjuncts[1:]:
+        out = ast.BinaryOp("AND", out, c)
+    return out
+
+
+def _refs_side(e: ast.Expr, inner: _Scope, outer: _Scope) -> str:
+    """'inner' | 'outer' | 'mixed' | 'none' | 'unknown' for expression e."""
+    saw_inner = saw_outer = saw_unknown = False
+    for r in _expr_refs(e):
+        if inner.resolves(r):
+            saw_inner = True
+        elif outer.resolves(r):
+            saw_outer = True
+        else:
+            saw_unknown = True
+    if saw_unknown:
+        return "unknown"
+    if saw_inner and saw_outer:
+        return "mixed"
+    if saw_inner:
+        return "inner"
+    if saw_outer:
+        return "outer"
+    return "none"
+
+
+class _InnerRefRewriter:
+    """Rewrites inner-scope ColumnRefs inside correlation predicates to
+    point at the derived table's projected __cN columns; assigns each
+    distinct inner column one projection slot."""
+
+    def __init__(self, inner: _Scope, alias: str):
+        self.inner = inner
+        self.alias = alias
+        self.slots: dict[ast.ColumnRef, str] = {}   # inner ref → __cN
+
+    def slot(self, ref: ast.ColumnRef) -> str:
+        name = self.slots.get(ref)
+        if name is None:
+            name = f"__c{len(self.slots)}"
+            self.slots[ref] = name
+        return name
+
+    def rewrite(self, e: ast.Expr) -> ast.Expr:
+        if isinstance(e, ast.ColumnRef):
+            if self.inner.resolves(e):
+                return ast.ColumnRef(self.slot(e), self.alias)
+            return e
+        return _map_children(e, self.rewrite)
+
+
+def _map_children(e: ast.Expr, fn) -> ast.Expr:
+    """Structural rebuild over the AST expression node kinds."""
+    if isinstance(e, ast.BinaryOp):
+        return ast.BinaryOp(e.op, fn(e.left), fn(e.right))
+    if isinstance(e, ast.UnaryOp):
+        return ast.UnaryOp(e.op, fn(e.operand))
+    if isinstance(e, ast.IsNull):
+        return ast.IsNull(fn(e.operand), e.negated)
+    if isinstance(e, ast.Between):
+        return ast.Between(fn(e.operand), fn(e.low), fn(e.high), e.negated)
+    if isinstance(e, ast.InList):
+        return ast.InList(fn(e.operand), tuple(fn(x) for x in e.items),
+                          e.negated)
+    if isinstance(e, ast.Like):
+        return ast.Like(fn(e.operand), fn(e.pattern), e.negated)
+    if isinstance(e, ast.FuncCall):
+        return ast.FuncCall(e.name, tuple(fn(a) for a in e.args),
+                            e.distinct, e.star, e.window)
+    if isinstance(e, ast.Cast):
+        return ast.Cast(fn(e.operand), e.type_name)
+    if isinstance(e, ast.Extract):
+        return ast.Extract(e.part, fn(e.operand))
+    if isinstance(e, ast.Substring):
+        return ast.Substring(fn(e.operand), fn(e.start),
+                             fn(e.length) if e.length is not None else None)
+    if isinstance(e, ast.CaseWhen):
+        return ast.CaseWhen(tuple((fn(c), fn(r)) for c, r in e.whens),
+                            fn(e.else_result)
+                            if e.else_result is not None else None)
+    return e
+
+
+# --------------------------------------------------------------------------
+# the rewrite
+# --------------------------------------------------------------------------
+
+def decorrelate_select(sel: ast.Select,
+                       columns_of: Callable[[str], Optional[frozenset]],
+                       ) -> ast.Select:
+    """Rewrite WHERE-conjunct-level correlated subqueries in `sel`.
+    Uncorrelated subqueries and non-conjunct placements pass through
+    untouched (the recursive planner's eager path owns them)."""
+    if sel.where is None:
+        return sel
+    outer = _build_scope(sel.from_items, columns_of)
+
+    kept: list[ast.Expr] = []
+    extra_from: list[ast.FromItem] = []
+    semis: list[ast.SemiJoin] = list(sel.semi_joins)
+    changed = False
+
+    for conj in _split_and(sel.where):
+        rewritten = _try_rewrite_conjunct(conj, outer, columns_of,
+                                          kept, extra_from, semis)
+        if rewritten:
+            changed = True
+        else:
+            kept.append(conj)
+
+    if not changed:
+        return sel
+    return dc_replace(sel, where=_make_and(kept),
+                      from_items=sel.from_items + tuple(extra_from),
+                      semi_joins=tuple(semis))
+
+
+def _try_rewrite_conjunct(conj, outer, columns_of, kept, extra_from,
+                          semis) -> bool:
+    """Returns True when the conjunct was consumed (its replacements are
+    appended to kept/extra_from/semis)."""
+    # EXISTS / NOT EXISTS ------------------------------------------------
+    if isinstance(conj, ast.Exists):
+        return _rewrite_exists(conj.query, conj.negated, outer, columns_of,
+                               semis)
+    if isinstance(conj, ast.UnaryOp) and conj.op.upper() == "NOT" and \
+            isinstance(conj.operand, ast.Exists):
+        inner_e = conj.operand
+        return _rewrite_exists(inner_e.query, not inner_e.negated, outer,
+                               columns_of, semis)
+
+    # correlated IN ------------------------------------------------------
+    if isinstance(conj, ast.InSubquery):
+        sub = conj.query
+        inner = _build_scope(sub.from_items, columns_of)
+        if not (inner.exact and outer.exact) or \
+                not _is_correlated(sub, inner, outer):
+            return False
+        if conj.negated:
+            raise UnsupportedQueryError(
+                "correlated NOT IN is not supported (its NULL semantics "
+                "differ from an anti join) — rewrite as NOT EXISTS")
+        if len(sub.items) != 1 or isinstance(sub.items[0].expr, ast.Star) \
+                or sub.group_by or ast.contains_aggregate(sub.items[0].expr):
+            raise UnsupportedQueryError(
+                "correlated IN supports a single plain output column")
+        if sub.order_by or sub.limit is not None or sub.offset is not None:
+            # LIMIT/ORDER BY restrict WHICH values the IN set contains;
+            # the EXISTS rewrite would test every row instead
+            raise UnsupportedQueryError(
+                "correlated IN with ORDER BY/LIMIT is not supported")
+        eq = ast.BinaryOp("=", sub.items[0].expr, conj.operand)
+        new_where = _make_and(_split_and(sub.where) + [eq])
+        sub2 = dc_replace(sub, where=new_where)
+        return _rewrite_exists(sub2, False, outer, columns_of, semis)
+
+    # comparison against a correlated scalar aggregate -------------------
+    if isinstance(conj, ast.BinaryOp) and conj.op in CMP_OPS:
+        for lhs, sub_e, op in ((conj.left, conj.right, conj.op),
+                               (conj.right, conj.left, _flip(conj.op))):
+            if isinstance(sub_e, ast.ScalarSubquery):
+                done = _rewrite_scalar_agg(lhs, op, sub_e.query, outer,
+                                           columns_of, kept, extra_from)
+                if done:
+                    return True
+    return False
+
+
+def _flip(op: str) -> str:
+    return {"<": ">", "<=": ">=", ">": "<", ">=": "<="}.get(op, op)
+
+
+def _rewrite_exists(sub: ast.Select, negated: bool, outer: _Scope,
+                    columns_of, semis) -> bool:
+    inner = _build_scope(sub.from_items, columns_of)
+    if not (inner.exact and outer.exact):
+        return False          # ambiguous resolution: leave for eager path
+    if not _is_correlated(sub, inner, outer):
+        return False          # uncorrelated EXISTS: eager path is exact
+    if sub.ctes or sub.group_by or sub.having is not None or any(
+            ast.contains_aggregate(it.expr) for it in sub.items):
+        raise UnsupportedQueryError(
+            "correlated EXISTS with aggregation/CTEs is not supported")
+    if sub.limit == 0:
+        raise UnsupportedQueryError(
+            "correlated EXISTS (... LIMIT 0) is not supported")
+    # a LIMIT >= 1 inside EXISTS is semantically inert — drop it
+
+    local: list[ast.Expr] = []
+    corr: list[ast.Expr] = []
+    for c in _split_and(sub.where):
+        side = _refs_side(c, inner, outer)
+        if side in ("inner", "none"):
+            local.append(c)
+        elif side == "unknown":
+            raise UnsupportedQueryError(
+                f"cannot resolve columns in correlated predicate {c}")
+        else:                 # mixed or pure-outer: correlation predicate
+            corr.append(c)
+    if not corr:
+        return False          # correlation sits outside WHERE — bail
+
+    alias = _fresh_alias()
+    rr = _InnerRefRewriter(inner, alias)
+    cond = [rr.rewrite(c) for c in corr]
+    if not rr.slots:
+        raise UnsupportedQueryError(
+            "correlated EXISTS needs at least one inner-column reference "
+            "in its correlation predicate")
+    items = tuple(ast.SelectItem(ref, name)
+                  for ref, name in rr.slots.items())
+    derived = ast.Select(items=items, from_items=sub.from_items,
+                         where=_make_and(local))
+    semis.append(ast.SemiJoin("anti" if negated else "semi",
+                              ast.SubqueryRef(derived, alias),
+                              _make_and(cond)))
+    return True
+
+
+def _rewrite_scalar_agg(lhs: ast.Expr, op: str, sub: ast.Select,
+                        outer: _Scope, columns_of, kept,
+                        extra_from) -> bool:
+    inner = _build_scope(sub.from_items, columns_of)
+    if not (inner.exact and outer.exact) or \
+            not _is_correlated(sub, inner, outer):
+        return False
+    if sub.ctes or sub.group_by or sub.having is not None or \
+            sub.distinct or sub.order_by or sub.limit is not None or \
+            len(sub.items) != 1:
+        raise UnsupportedQueryError(
+            "correlated scalar subquery must be a bare aggregate")
+    item = sub.items[0].expr
+    if not ast.contains_aggregate(item):
+        raise UnsupportedQueryError(
+            "correlated scalar subquery must aggregate (a bare correlated "
+            "SELECT can return multiple rows)")
+    for n in ast.walk_expr(item):
+        if ast.is_aggregate_call(n) and n.name == "count":
+            raise UnsupportedQueryError(
+                "correlated count() is not supported: empty groups "
+                "compare against 0, which the decorrelated join drops")
+
+    local: list[ast.Expr] = []
+    edges: list[tuple[ast.Expr, ast.Expr]] = []   # (inner_expr, outer_expr)
+    for c in _split_and(sub.where):
+        side = _refs_side(c, inner, outer)
+        if side in ("inner", "none"):
+            local.append(c)
+            continue
+        if side == "unknown":
+            raise UnsupportedQueryError(
+                f"cannot resolve columns in correlated predicate {c}")
+        if not (isinstance(c, ast.BinaryOp) and c.op == "="):
+            raise UnsupportedQueryError(
+                "correlated scalar aggregates support equality "
+                f"correlation only (got {c})")
+        ls = _refs_side(c.left, inner, outer)
+        rs = _refs_side(c.right, inner, outer)
+        if ls == "inner" and rs == "outer":
+            edges.append((c.left, c.right))
+        elif ls == "outer" and rs == "inner":
+            edges.append((c.right, c.left))
+        else:
+            raise UnsupportedQueryError(
+                "correlated equality must compare an inner expression "
+                f"with an outer expression (got {c})")
+    if not edges:
+        return False
+
+    alias = _fresh_alias()
+    items = [ast.SelectItem(ie, f"__k{i}") for i, (ie, _) in
+             enumerate(edges)]
+    items.append(ast.SelectItem(item, "__v"))
+    derived = ast.Select(items=tuple(items), from_items=sub.from_items,
+                         where=_make_and(local),
+                         group_by=tuple(ie for ie, _ in edges))
+    extra_from.append(ast.SubqueryRef(derived, alias))
+    for i, (_, oe) in enumerate(edges):
+        kept.append(ast.BinaryOp("=", oe,
+                                 ast.ColumnRef(f"__k{i}", alias)))
+    kept.append(ast.BinaryOp(op, lhs, ast.ColumnRef("__v", alias)))
+    return True

@@ -79,7 +79,12 @@ def _format_node(node: PlanNode, lines: list[str], depth: int) -> None:
         return
     if isinstance(node, JoinNode):
         label = _JOIN_LABEL.get(node.strategy, node.strategy)
-        if node.join_type != "inner":
+        if node.join_type in ("semi", "anti"):
+            kind = "Semi" if node.join_type == "semi" else "Anti"
+            label = f"{kind} {label}"
+            if node.flag_combine:
+                label += " (psum flags)"
+        elif node.join_type != "inner":
             label = f"{node.join_type.capitalize()} Outer {label}"
         conds = ", ".join(f"{l} = {r}" for l, r in
                           zip(node.left_keys, node.right_keys))

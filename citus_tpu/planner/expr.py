@@ -162,6 +162,26 @@ class BExtract(BExpr):
 
 
 @dataclass(frozen=True)
+class BStrRemap(BExpr):
+    """String function over a dictionary-encoded column, lowered to a
+    code remap: the (small) dictionary is transformed host-side at bind
+    time and the device does ONE gather `lut[codes]` — no device string
+    ops, the TPU-native shape for text functions (the reference evaluates
+    text functions row-by-row in the executor; here they collapse to a
+    per-distinct-value precomputation).  `values[new_code]` is the output
+    dictionary used for decode and further predicate binding."""
+
+    operand: BExpr              # STRING-typed input (codes on device)
+    lut: tuple[int, ...]        # old code → new code
+    values: tuple[str, ...]     # new code → string
+    label: str = "strmap"       # display only (e.g. "substring(1,2)")
+    dtype: DataType = DataType.STRING
+
+    def __str__(self):
+        return f"{self.label}({self.operand})"
+
+
+@dataclass(frozen=True)
 class BAgg(BExpr):
     """Aggregate call; appears only in Aggregate plan nodes."""
 
@@ -223,7 +243,7 @@ def children(e: BExpr) -> tuple:
         return (e.left, e.right)
     if isinstance(e, BBool):
         return e.args
-    if isinstance(e, (BIsNull, BCast, BExtract)):
+    if isinstance(e, (BIsNull, BCast, BExtract, BStrRemap)):
         return (e.operand,)
     if isinstance(e, BInConst):
         return (e.operand,)

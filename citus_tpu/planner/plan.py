@@ -95,8 +95,10 @@ class JoinNode(PlanNode):
     # partner's distribution column — the shuffle hashes ONLY that key
     # (hashing all keys would route rows off the partner's shards)
     repart_key_idx: int = 0
-    # inner | left | right | full — relative to THIS node's sides ('left'
-    # preserves the probe/left side, 'right' the build/right side)
+    # inner | left | right | full | semi | anti — relative to THIS node's
+    # sides ('left' preserves the probe/left side, 'right' the build/right
+    # side; semi/anti filter the probe side by match existence and emit
+    # probe columns only)
     join_type: str = "inner"
     # estimated matches per probe row (build_rows / build-key ndv): sizes
     # the join-output buffer so many-to-many joins don't start at the
@@ -106,6 +108,9 @@ class JoinNode(PlanNode):
     # filtering the preserved side's rows (ON vs WHERE distinction)
     left_match_filter: Optional[ir.BExpr] = None
     right_match_filter: Optional[ir.BExpr] = None
+    # semi/anti only: probe side replicated over a sharded build — the
+    # executor psum-combines per-device match flags across the mesh
+    flag_combine: bool = False
     # which side the executor sorts / builds a key directory over (the
     # smaller side for inner joins; outer joins keep 'right' — the
     # null-extension machinery is oriented build=right)
@@ -506,24 +511,30 @@ class DistributedPlanner:
             candidates = idx if candidates is None else (candidates & idx)
         return sorted(candidates) if candidates is not None else None
 
-    # -- outer joins -------------------------------------------------------
+    # -- outer + semi/anti joins ------------------------------------------
     def _classify_outer_on(self, spec, q: BoundQuery) -> dict:
         """ON conjuncts → equi edges + single-side gates + scan pushdowns.
 
         A predicate over only the NON-preserved side may push into that
         side's scan (its rows vanish from the result anyway); a predicate
         over only the PRESERVED side becomes a match gate (rows failing it
-        still emit, null-extended).  Cross-side non-equi residuals are not
-        supported with outer joins yet."""
+        still emit, null-extended).  Cross-side non-equi residuals are
+        supported for semi/anti joins only (they gate match existence —
+        the Q21 `l2.l_suppkey <> l1.l_suppkey` shape); outer joins still
+        reject them."""
         right = spec.right_rel_index
+        semi = spec.join_type in ("semi", "anti")
         edges = []
         left_gate: list[ir.BExpr] = []
         right_gate: list[ir.BExpr] = []
+        residual: list[ir.BExpr] = []
         push: dict[int, list[ir.BExpr]] = {}
         for c in spec.on:
             rels = {n.rel_index for n in ir.walk(c) if isinstance(n, ir.BCol)}
             if rels <= {right}:
-                if spec.join_type == "left":
+                if spec.join_type in ("left", "semi", "anti"):
+                    # semi/anti: a pure-inner predicate restricts which
+                    # rows EXIST in the subquery side → scan filter
                     push.setdefault(right, []).append(c)
                 else:  # right/full preserve the right side → gate only
                     right_gate.append(c)
@@ -531,10 +542,12 @@ class DistributedPlanner:
             if right not in rels:
                 if spec.join_type == "right" and len(rels) == 1:
                     push.setdefault(next(iter(rels)), []).append(c)
-                else:  # left/full preserve the tree side → gate only
+                else:  # left/full/semi/anti preserve the tree side → gate
                     left_gate.append(c)
                 continue
-            if (isinstance(c, ir.BCmp) and c.op == "=" and len(rels) == 2):
+            if (isinstance(c, ir.BCmp) and c.op == "=" and len(rels) == 2
+                    and c.left.dtype.value not in ("float32", "float64")
+                    and c.right.dtype.value not in ("float32", "float64")):
                 lrels = {n.rel_index for n in ir.walk(c.left)
                          if isinstance(n, ir.BCol)}
                 rrels = {n.rel_index for n in ir.walk(c.right)
@@ -542,13 +555,18 @@ class DistributedPlanner:
                 if len(lrels) == 1 and len(rrels) == 1 and lrels != rrels:
                     edges.append((frozenset(rels), c.left, c.right))
                     continue
+            if semi:
+                residual.append(c)  # evaluated per candidate pair
+                continue
             raise PlanningError(
                 "outer join ON supports equality keys and single-side "
                 "predicates only")
         if not edges:
-            raise PlanningError("outer joins require an equality join key")
+            kind = ("correlated EXISTS/IN" if semi else "outer joins")
+            raise PlanningError(f"{kind} require an equality join key")
         return {"spec": spec, "edges": edges, "left_gate": left_gate,
-                "right_gate": right_gate, "push": push}
+                "right_gate": right_gate, "residual": residual,
+                "push": push}
 
     def _apply_outer_join(self, current: PlanNode, scan: ScanNode,
                           info: dict, placed: set[int]) -> PlanNode:
@@ -558,6 +576,8 @@ class DistributedPlanner:
             raise PlanningError(
                 f"{spec.join_type.upper()} JOIN cannot combine with other "
                 "FROM entries (its left side must be the whole join tree)")
+        if spec.join_type in ("semi", "anti"):
+            return self._apply_semi_join(current, scan, info)
         strategy = self._choose_strategy(current, scan, info["edges"])
         if strategy in ("cartesian", "cartesian_broadcast"):
             raise PlanningError("outer joins require an equality join key")
@@ -573,6 +593,41 @@ class DistributedPlanner:
             info["left_gate"] if swapped else info["right_gate"])
         return node
 
+    def _apply_semi_join(self, current: PlanNode, scan: ScanNode,
+                         info: dict) -> PlanNode:
+        """Semi/anti join: probe (tree) rows filtered by match existence
+        against the subquery relation.  Sides never swap — the probe side
+        is always the tree.  When the probe is replicated and the build
+        sharded, each device sees only part of the build, so the executor
+        psum-combines the per-device match flags (`flag_combine`)."""
+        spec = info["spec"]
+        strategy = self._choose_strategy(current, scan, info["edges"])
+        flag_combine = False
+        if strategy in ("cartesian", "cartesian_broadcast"):
+            raise PlanningError(
+                "correlated EXISTS/IN require an equality correlation")
+        if strategy == "broadcast_left":
+            # probe replicated, build sharded: run devicewise and combine
+            # match flags across the mesh instead of swapping sides
+            strategy = "local"
+            flag_combine = self.n_devices > 1
+        node = self._make_join(current, scan, info["edges"], strategy,
+                               scan.rel.rel_index,
+                               join_type=spec.join_type)
+        assert node.left is current, "semi join sides must not swap"
+        node.flag_combine = flag_combine
+        if flag_combine:
+            node.dist = current.dist
+        node.left_match_filter = ir.make_and(info["left_gate"])
+        node.right_match_filter = ir.make_and(info["right_gate"])
+        if info["residual"]:
+            node.residual = ir.make_and(info["residual"])
+        # output = probe rows only; the build side's columns vanish
+        node.out_columns = dict(current.out_columns)
+        sel = 0.5  # default semi-join selectivity (no distinct stats)
+        node.est_rows = max(1, int(current.est_rows * sel))
+        return node
+
     # -- join order + strategies ------------------------------------------
     def _plan_joins(self, q: BoundQuery, scans: dict[int, ScanNode],
                     inner_conjuncts: list[ir.BExpr],
@@ -583,7 +638,15 @@ class DistributedPlanner:
                        if ri not in outer_rels}
         current = self._plan_inner_joins(q, inner_scans, inner_conjuncts)
         placed = set(inner_scans)
+        # true outer joins first; then post-join WHERE conjuncts (they
+        # filter null-extended rows, so they must precede semi/anti
+        # application only logically — semi nodes' residual field means
+        # "pair-match residual", never an output filter)
+        semi_info = [i for i in outer_info
+                     if i["spec"].join_type in ("semi", "anti")]
         for info in outer_info:
+            if info["spec"].join_type in ("semi", "anti"):
+                continue
             spec = info["spec"]
             current = self._apply_outer_join(
                 current, scans[spec.right_rel_index], info, placed)
@@ -595,6 +658,11 @@ class DistributedPlanner:
             res = ir.make_and(post_conjuncts)
             current.residual = (res if current.residual is None
                                 else ir.make_and([current.residual, res]))
+        for info in semi_info:
+            spec = info["spec"]
+            current = self._apply_outer_join(
+                current, scans[spec.right_rel_index], info, placed)
+            placed.add(spec.right_rel_index)
         return current
 
     def _plan_inner_joins(self, q: BoundQuery,
@@ -610,7 +678,12 @@ class DistributedPlanner:
             rels = {n.rel_index for n in ir.walk(c) if isinstance(n, ir.BCol)}
             if len(rels) <= 1:
                 continue
-            if (isinstance(c, ir.BCmp) and c.op == "=" and len(rels) == 2):
+            if (isinstance(c, ir.BCmp) and c.op == "=" and len(rels) == 2
+                    and c.left.dtype.value not in ("float32", "float64")
+                    and c.right.dtype.value not in ("float32", "float64")):
+                # float equalities (e.g. Q2's decorrelated
+                # ps_supplycost = min-cost) can't drive the key
+                # machinery — they join as residual filters instead
                 lrels = {n.rel_index for n in ir.walk(c.left)
                          if isinstance(n, ir.BCol)}
                 rrels = {n.rel_index for n in ir.walk(c.right)
@@ -812,8 +885,10 @@ class DistributedPlanner:
         if node.join_type != "inner" and node.dist is not None:
             # null-extended rows carry NULL partition values, so only the
             # preserved side's own partition columns survive as a reliable
-            # distribution property (no equivalence-extension either)
-            if node.join_type == "left":
+            # distribution property (no equivalence-extension either).
+            # semi/anti output IS the probe side (no null extension), so
+            # the probe's partition columns survive like 'left'
+            if node.join_type in ("left", "semi", "anti"):
                 keep = node.dist.cids & node.left.dist.cids
             elif node.join_type == "right":
                 keep = node.dist.cids & node.right.dist.cids
@@ -935,6 +1010,10 @@ class DistributedPlanner:
             group_map[g] = ir.BCol(cid, g.dtype)
             if isinstance(g, ir.BCol) and g.dtype == DataType.STRING:
                 decode[cid] = (g.table, g.column)
+            elif isinstance(g, ir.BStrRemap):
+                from ..storage.dictionary import EXPR_DICT
+
+                decode[cid] = (EXPR_DICT, g.values)
 
         aggs: list[tuple[ir.BAgg, str]] = []
         agg_map: dict[ir.BAgg, ir.BExpr] = {}
@@ -1219,6 +1298,10 @@ class DistributedPlanner:
             col_by_expr[e] = col
             if isinstance(e, ir.BCol) and e.dtype == DataType.STRING:
                 decode[cid] = (e.table, e.column)
+            elif isinstance(e, ir.BStrRemap):
+                from ..storage.dictionary import EXPR_DICT
+
+                decode[cid] = (EXPR_DICT, e.values)
             return col
 
         for i, (e, name) in enumerate(q.select):
@@ -1261,6 +1344,8 @@ def _rebuild(e: ir.BExpr, new_children: list[ir.BExpr]) -> ir.BExpr:
         return ir.BInConst(new_children[0], e.values, e.negated)
     if isinstance(e, ir.BCast):
         return ir.BCast(new_children[0], e.dtype)
+    if isinstance(e, ir.BStrRemap):
+        return ir.BStrRemap(new_children[0], e.lut, e.values, e.label)
     if isinstance(e, ir.BExtract):
         return ir.BExtract(e.part, new_children[0])
     if isinstance(e, ir.BCase):

@@ -929,15 +929,31 @@ class Session:
 
     def _recursive_plan(self, sel: ast.Select, cleanup: list[str],
                         cte_scope: dict[str, str] | None = None) -> ast.Select:
+        from .planner.decorrelate import decorrelate_select
+
         cte_scope = dict(cte_scope or {})
         for cte in sel.ctes:
             inner = self._recursive_plan(cte.query, cleanup, cte_scope)
             temp = self._materialize(self._sub_params(inner), cleanup,
                                      cte.column_names)
             cte_scope[cte.name] = temp
+
+        def columns_of(name: str):
+            name = cte_scope.get(name, name)
+            if not self.catalog.has_table(name):
+                return None
+            return frozenset(
+                c.name for c in self.catalog.table(name).schema.columns)
+
+        sel = decorrelate_select(sel, columns_of)
         new_from = tuple(self._rewrite_from(fi, cleanup, cte_scope)
                          for fi in sel.from_items)
         rewrite = lambda e: self._rewrite_expr(e, cleanup, cte_scope)  # noqa: E731
+        new_semis = tuple(
+            ast.SemiJoin(sj.join_type,
+                         self._rewrite_from(sj.item, cleanup, cte_scope),
+                         rewrite(sj.condition))
+            for sj in sel.semi_joins)
         return ast.Select(
             items=tuple(ast.SelectItem(rewrite(i.expr), i.alias)
                         for i in sel.items),
@@ -949,7 +965,7 @@ class Session:
                                          o.nulls_first)
                            for o in sel.order_by),
             limit=sel.limit, offset=sel.offset, distinct=sel.distinct,
-            ctes=())
+            ctes=(), semi_joins=new_semis)
 
     def _rewrite_from(self, fi: ast.FromItem, cleanup, cte_scope):
         if isinstance(fi, ast.TableRef):

@@ -382,6 +382,19 @@ class CommonTableExpr(Node):
 
 
 @dataclass(frozen=True)
+class SemiJoin(Node):
+    """A WHERE-level EXISTS / NOT EXISTS decorrelated into a join: the
+    whole FROM tree semi-joins (anti-joins) `item` on `condition`.  Only
+    produced by the decorrelation rewrite (planner/decorrelate.py) — no
+    SQL surface spells it directly.  `item`'s columns are invisible to
+    the rest of the query."""
+
+    join_type: str        # semi | anti
+    item: FromItem        # TableRef after recursive planning
+    condition: Expr       # correlation predicates (AND-conjoined)
+
+
+@dataclass(frozen=True)
 class Select(Statement):
     items: tuple[SelectItem, ...]
     from_items: tuple[FromItem, ...] = ()   # comma-separated = implicit cross
@@ -393,6 +406,8 @@ class Select(Statement):
     offset: Optional[int] = None
     distinct: bool = False
     ctes: tuple[CommonTableExpr, ...] = ()
+    # decorrelated EXISTS/NOT EXISTS clauses (applied after from_items)
+    semi_joins: tuple[SemiJoin, ...] = ()
 
 
 @dataclass(frozen=True)

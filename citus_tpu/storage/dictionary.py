@@ -42,6 +42,37 @@ def string_hash_token(value: str) -> int:
     return int(fmix32(np.uint32(crc)).view(np.int32)[0])
 
 
+# decode-map sentinel: a (table, column) decode entry whose "table" is
+# EXPR_DICT carries the value list itself in the "column" slot — used for
+# string-expression outputs (BStrRemap) that have no backing table column
+EXPR_DICT = "__expr__"
+
+
+class ValuesDictionary:
+    """Read-only dictionary view over a literal value list (the output
+    dictionary of a string-expression remap)."""
+
+    def __init__(self, values):
+        self.values = list(values)
+
+    def __len__(self) -> int:
+        return len(self.values)
+
+    def code_of(self, value: str):
+        try:
+            return self.values.index(value)
+        except ValueError:
+            return None
+
+
+def resolve_decode(store, entry):
+    """Decode-map entry → dictionary-like object with .values."""
+    table, column = entry
+    if table == EXPR_DICT:
+        return ValuesDictionary(column)
+    return store.dictionary(table, column)
+
+
 def string_hash_tokens(values: list[str]) -> np.ndarray:
     if len(values) >= _NATIVE_MIN_BATCH:
         from ..native import get_lib, pack_strings, string_hash_tokens_packed
@@ -195,7 +226,10 @@ class Dictionary:
         return self._pack
 
     def code_of(self, value: str) -> int | None:
-        return self._codes_map().get(value)
+        # must hold _mu: _codes_map() may rebuild+assign self._codes, and
+        # doing that unlocked races intern_array (one string, two codes)
+        with self._mu:
+            return self._codes_map().get(value)
 
     def value_of(self, code: int) -> str:
         if not 0 <= code < len(self._values):

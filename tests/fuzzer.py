@@ -88,6 +88,10 @@ class Fuzz:
     plain_select: list[str] = field(default_factory=list)
     having: str | None = None
     order_limit: str | None = None
+    # correlated subquery WHERE fragments: (rendered_sql, outer_table)
+    # — exercise the decorrelation path (semi/anti joins, grouped
+    # derived tables)
+    subqueries: list[tuple] = field(default_factory=list)
 
     def sql(self) -> str:
         frm = self.tables[0]
@@ -100,8 +104,9 @@ class Fuzz:
         else:
             items = self.plain_select
         q = f"select {', '.join(items)} from {frm}"
-        if self.filters:
-            q += " where " + " and ".join(self.filters)
+        where = self.filters + [frag for frag, _ in self.subqueries]
+        if where:
+            q += " where " + " and ".join(where)
         if self.group_by:
             q += " group by " + ", ".join(self.group_by)
         if self.having:
@@ -135,6 +140,48 @@ def _rand_filter(rng: random.Random, tables) -> str | None:
     return f"{name} {op} {rng.choice(FLOAT_POOL)}"
 
 
+def _rand_corr_subquery(rng: random.Random, tables):
+    """Correlated EXISTS / NOT EXISTS / scalar-agg fragment along an FK
+    edge whose inner table is NOT in the outer FROM (unambiguous names).
+    Returns (sql_fragment, outer_table) or None."""
+    options = []
+    for ltab, lcol, rtab, rcol, kind in EDGES:
+        if kind != "fk":
+            continue
+        if ltab in tables and rtab not in tables:
+            options.append((ltab, lcol, rtab, rcol))
+        elif rtab in tables and ltab not in tables:
+            options.append((rtab, rcol, ltab, lcol))
+    if not options:
+        return None
+    outer_tab, outer_col, inner_tab, inner_col = rng.choice(options)
+    local = _rand_filter(rng, [inner_tab])
+    cond = f"{inner_col} = {outer_col}"
+    if local and rng.random() < 0.5:
+        cond += f" and {local}"
+    if rng.random() < 0.55:
+        neg = "not " if rng.random() < 0.5 else ""
+        return (f"{neg}exists (select 1 from {inner_tab} where {cond})",
+                outer_tab)
+    # correlated scalar aggregate under a comparison.  count() is
+    # unsupported by design (empty-group semantics); float sum/avg are
+    # skipped because accumulation-order rounding could flip the
+    # comparison at boundaries between the two engines
+    int_cols = [c for c, k in TABLES[inner_tab] if k == "int"]
+    float_cols = [c for c, k in TABLES[inner_tab] if k == "float"]
+    if rng.random() < 0.5 and int_cols:
+        name = rng.choice(int_cols)
+        fn = rng.choice(["sum", "min", "max", "avg"])
+    else:
+        name = rng.choice(float_cols or int_cols)
+        fn = rng.choice(["min", "max"])
+    ocols = [c for c, k in TABLES[outer_tab] if k in ("int", "float")]
+    ocol = rng.choice(ocols)
+    op = rng.choice(["<", "<=", ">", ">="])
+    return (f"{ocol} {op} (select {fn}({name}) from {inner_tab} "
+            f"where {cond})", outer_tab)
+
+
 def generate(rng: random.Random) -> Fuzz:
     start = rng.choice(list(TABLES))
     tables = [start]
@@ -162,6 +209,10 @@ def generate(rng: random.Random) -> Fuzz:
         flt = _rand_filter(rng, tables)
         if flt:
             f.filters.append(flt)
+    if rng.random() < 0.35:
+        sub = _rand_corr_subquery(rng, tables)
+        if sub:
+            f.subqueries.append(sub)
 
     cols = _columns_of(tables)
     if rng.random() < 0.65:  # aggregate mode
@@ -225,6 +276,9 @@ def shrink(q: Fuzz, still_fails) -> Fuzz:
         for i in range(len(q.filters)):
             candidates.append(replace(
                 q, filters=q.filters[:i] + q.filters[i + 1:]))
+        for i in range(len(q.subqueries)):
+            candidates.append(replace(
+                q, subqueries=q.subqueries[:i] + q.subqueries[i + 1:]))
         if q.joins:
             dropped = q.joins[-1]
             keep_tabs = [t for t in q.tables if t != dropped[2]]
@@ -243,7 +297,9 @@ def shrink(q: Fuzz, still_fails) -> Fuzz:
                               if c in cols_left] or
                 (list(cols_left)[:1] if not q.aggs else []),
                 having=q.having if q.having and refs_ok(q.having) else None,
-                order_limit=None if q.order_limit else None))
+                order_limit=None if q.order_limit else None,
+                subqueries=[s for s in q.subqueries
+                            if s[1] in keep_tabs]))
         if len(q.aggs) > 1:
             for i in range(len(q.aggs)):
                 candidates.append(replace(

@@ -45,6 +45,12 @@ def run_oracle(conn: sqlite3.Connection, sql: str) -> list[tuple]:
     sql = re.sub(r"extract\s*\(\s*year\s+from\s+(\w+)\s*\)",
                  r"cast(strftime('%Y', \1) as integer)", sql,
                  flags=re.IGNORECASE)
+    # SQL-standard substring(x from a for n) → sqlite substr(x, a, n)
+    sql = re.sub(r"substring\s*\(\s*([\w.]+)\s+from\s+(\d+)\s+for\s+"
+                 r"(\d+)\s*\)", r"substr(\1, \2, \3)", sql,
+                 flags=re.IGNORECASE)
+    sql = re.sub(r"substring\s*\(\s*([\w.]+)\s+from\s+(\d+)\s*\)",
+                 r"substr(\1, \2)", sql, flags=re.IGNORECASE)
     return conn.execute(sql).fetchall()
 
 

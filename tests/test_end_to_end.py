@@ -76,6 +76,26 @@ class TestTPCH:
     def test_q19(self, tpch_session, oracle_conn):
         check(tpch_session, oracle_conn, tpch.Q19)
 
+    # correlated-subquery queries (decorrelate.py semi/anti + grouped
+    # derived tables) — Q2/Q4/Q17/Q20/Q21/Q22
+    def test_q2(self, tpch_session, oracle_conn):
+        check(tpch_session, oracle_conn, tpch.Q2)
+
+    def test_q4(self, tpch_session, oracle_conn):
+        check(tpch_session, oracle_conn, tpch.Q4)
+
+    def test_q17(self, tpch_session, oracle_conn):
+        check(tpch_session, oracle_conn, tpch.Q17)
+
+    def test_q20(self, tpch_session, oracle_conn):
+        check(tpch_session, oracle_conn, tpch.Q20)
+
+    def test_q21(self, tpch_session, oracle_conn):
+        check(tpch_session, oracle_conn, tpch.Q21)
+
+    def test_q22(self, tpch_session, oracle_conn):
+        check(tpch_session, oracle_conn, tpch.Q22)
+
 
 class TestQueryShapes:
     """Smaller targeted shapes (multi_schedule-style coverage)."""

@@ -1,0 +1,137 @@
+"""Correlated-subquery decorrelation: semi/anti joins + grouped derived
+tables (planner/decorrelate.py; reference: recursive_planning.c:223 and
+local_distributed_join_planner.c correlated rewrites)."""
+
+import pytest
+
+import citus_tpu
+from citus_tpu.errors import UnsupportedQueryError
+
+
+@pytest.fixture(scope="module")
+def sess(tmp_path_factory):
+    s = citus_tpu.connect(
+        data_dir=str(tmp_path_factory.mktemp("semi")),
+        n_devices=4, compute_dtype="float64")
+    s.execute("create table o (ok bigint, ck bigint, v bigint)")
+    s.create_distributed_table("o", "ok", shard_count=4)
+    s.execute("create table l (lk bigint, sk bigint, q bigint)")
+    s.create_distributed_table("l", "lk", shard_count=4)
+    s.execute("create table r (rk bigint, tag text)")
+    s.create_reference_table("r")
+    s.execute("insert into o values (1,10,100),(2,20,200),(3,30,300),"
+              "(4,40,400)")
+    s.execute("insert into l values (1,7,5),(1,8,6),(3,7,9),(5,9,1)")
+    s.execute("insert into r values (1,'a'),(2,'b'),(9,'z')")
+    return s
+
+
+class TestSemiAnti:
+    def test_exists_semi(self, sess):
+        r = sess.execute("select ok, v from o where exists "
+                         "(select 1 from l where lk = ok) order by ok")
+        assert r.rows() == [(1, 100), (3, 300)]
+
+    def test_not_exists_anti(self, sess):
+        r = sess.execute("select ok from o where not exists "
+                         "(select 1 from l where lk = ok) order by ok")
+        assert r.rows() == [(2,), (4,)]
+
+    def test_local_predicate_pushdown(self, sess):
+        r = sess.execute("select ok from o where exists "
+                         "(select 1 from l where lk = ok and q > 5) "
+                         "order by ok")
+        assert r.rows() == [(1,), (3,)]
+
+    def test_cross_side_residual(self, sess):
+        # non-equi correlation rides the pair-expansion residual path
+        r = sess.execute("select ok from o where exists "
+                         "(select 1 from l where lk = ok and sk <> ck) "
+                         "order by ok")
+        assert r.rows() == [(1,), (3,)]
+
+    def test_anti_with_residual(self, sess):
+        r = sess.execute("select ok from o where not exists "
+                         "(select 1 from l where lk = ok and q >= 9) "
+                         "order by ok")
+        # ok=3 has a q=9 match -> anti drops it; 1's rows are q=5,6
+        assert r.rows() == [(1,), (2,), (4,)]
+
+    def test_exists_against_reference_table(self, sess):
+        r = sess.execute("select ok from o where exists "
+                         "(select 1 from r where rk = ok) order by ok")
+        assert r.rows() == [(1,), (2,)]
+
+    def test_correlated_in(self, sess):
+        r = sess.execute("select ok from o where ck in "
+                         "(select sk + 3 from l where lk = ok) order by ok")
+        assert r.rows() == [(1,)]
+
+    def test_semi_under_aggregate(self, sess):
+        r = sess.execute("select count(*), sum(v) from o where exists "
+                         "(select 1 from l where lk = ok)")
+        assert r.rows() == [(2, 400)]
+
+    def test_two_subqueries_one_query(self, sess):
+        r = sess.execute(
+            "select ok from o where exists (select 1 from l where lk = ok)"
+            " and not exists (select 1 from l where lk = ok and q > 8) "
+            "order by ok")
+        # semi keeps {1,3}; anti over q>8 removes 3 (has q=9)
+        assert r.rows() == [(1,)]
+
+
+class TestScalarAgg:
+    def test_correlated_scalar_agg(self, sess):
+        r = sess.execute("select ok from o where v > "
+                         "(select 20 * sum(q) from l where lk = ok) "
+                         "order by ok")
+        # ok=1: 100 > 220 F; ok=3: 300 > 180 T; 2,4: no group -> dropped
+        assert r.rows() == [(3,)]
+
+    def test_empty_group_drops_row(self, sess):
+        r = sess.execute("select ok from o where v >= "
+                         "(select min(q) from l where lk = ok) order by ok")
+        assert r.rows() == [(1,), (3,)]
+
+    def test_correlated_count_rejected(self, sess):
+        with pytest.raises(UnsupportedQueryError, match="count"):
+            sess.execute("select ok from o where 0 = "
+                         "(select count(*) from l where lk = ok)")
+
+    def test_correlated_not_in_rejected(self, sess):
+        with pytest.raises(UnsupportedQueryError, match="NOT IN"):
+            sess.execute("select ok from o where ck not in "
+                         "(select sk from l where lk = ok)")
+
+
+class TestExplain:
+    def test_semi_join_in_plan(self, sess):
+        r = sess.execute("explain select ok from o where exists "
+                         "(select 1 from l where lk = ok)")
+        text = "\n".join(r.rows()[i][0] for i in range(r.row_count))
+        assert "Semi" in text
+
+    def test_anti_join_in_plan(self, sess):
+        r = sess.execute("explain select ok from o where not exists "
+                         "(select 1 from l where lk = ok)")
+        text = "\n".join(r.rows()[i][0] for i in range(r.row_count))
+        assert "Anti" in text
+
+
+class TestSubstring:
+    def test_substring_projection_and_group(self, sess):
+        sess.execute("create table ph (pk bigint, phone text)")
+        sess.create_distributed_table("ph", "pk", shard_count=4)
+        sess.execute("insert into ph values (1,'13-555'),(2,'31-444'),"
+                     "(3,'13-333'),(4,'99-000')")
+        r = sess.execute(
+            "select substring(phone from 1 for 2) as cc, count(*) "
+            "from ph group by cc order by cc")
+        assert r.rows() == [("13", 2), ("31", 1), ("99", 1)]
+
+    def test_substring_predicate(self, sess):
+        r = sess.execute(
+            "select pk from ph where substring(phone from 1 for 2) in "
+            "('13', '31') order by pk")
+        assert r.rows() == [(1,), (2,), (3,)]
