@@ -100,6 +100,16 @@ def main() -> None:
         configs check this before starting so the headline always runs."""
         return time.perf_counter() - t_start > budget * share
 
+    # measured CPU rows (bench_cpu_baseline.py; sqlite3 on this host) —
+    # a second, honest denominator next to the reference yardstick
+    cpu_rows = {}
+    try:
+        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "BASELINE.json")) as f:
+            cpu_rows = json.load(f).get("cpu_baseline", {})
+    except Exception:
+        pass
+
     def emit(name, rate, best, this_sf, unit="rows/s",
              baseline=BASELINE_ROWS_PER_SEC):
         line = {
@@ -110,6 +120,10 @@ def main() -> None:
             "seconds": round(best, 4),
             "sf": this_sf,
         }
+        cpu = cpu_rows.get(name)
+        if cpu and cpu.get("sf") == this_sf and cpu.get("rows_per_sec"):
+            line["vs_cpu"] = round(rate / cpu["rows_per_sec"], 3)
+            line["cpu_engine"] = cpu.get("engine", "")
         lines.append(line)
         # print + flush immediately: a timeout later in the run must not
         # erase configs that already finished (round-3 postmortem).
@@ -152,6 +166,38 @@ def main() -> None:
             rate, best = bench_cold_scan(sess, n_li)
             emit("columnar_scan_gb_per_sec", rate, best, sf, unit="GB/s",
                  baseline=BASELINE_SCAN_GB_PER_SEC)
+
+        # -- INSERT..SELECT modes (reference README: pushdown ~100M vs
+        #    repartition ~10M rows/s — here the colocated path writes
+        #    per-device blocks directly, no hash routing) ----------------
+        is_wanted = {"insert_select_colocated_rows_per_sec",
+                     "insert_select_repartition_rows_per_sec"}
+        is_run = is_wanted if only is None else is_wanted & only
+        if is_run and over_budget(0.75):
+            print("# budget: skipping INSERT..SELECT section",
+                  file=sys.stderr)
+            is_run = set()
+        for name, dist_col in (
+                ("insert_select_colocated_rows_per_sec", "o_orderkey"),
+                ("insert_select_repartition_rows_per_sec", "o_custkey")):
+            if name not in is_run:
+                continue
+            from citus_tpu.ingest.tpch import SCHEMAS
+
+            best = float("inf")
+            for _ in range(2):  # first run pays the source-plan compile
+                ddl = SCHEMAS["orders"].replace("orders", "bench_is_dst")
+                sess.execute(ddl)
+                sess.create_distributed_table(
+                    "bench_is_dst", dist_col,
+                    colocate_with="orders" if dist_col == "o_orderkey"
+                    else None)
+                t0 = time.perf_counter()
+                sess.execute(
+                    "insert into bench_is_dst select * from orders")
+                best = min(best, time.perf_counter() - t0)
+                sess.execute("drop table bench_is_dst")
+            emit(name, n_ord / best, best, sf)
 
         # -- SF10 section (BASELINE config #4 at scale; opt-in) -----------
         sf10_wanted = {"dual_repartition_join_sf10_rows_per_sec",

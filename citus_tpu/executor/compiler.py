@@ -825,6 +825,17 @@ class PlanCompiler:
         keep_r = node.join_type in ("right", "full")  # build side preserved
         if node.strategy in ("local", "broadcast"):
             pass
+        elif node.strategy == "cartesian_gather":
+            # sharded × sharded keyless product: replicate the build side
+            # on every device with one all_gather over ICI, then the
+            # normal keyless pair emission crosses it with the local
+            # probe shard
+            def _ag(x):
+                return jax.lax.all_gather(x, SHARD_AXIS, tiled=True)
+
+            rblk = Block({cid: _ag(a) for cid, a in rblk.columns.items()},
+                         _ag(rblk.valid),
+                         {cid: _ag(m) for cid, m in rblk.nulls.items()})
         elif node.strategy == "repart_right":
             # hash ONLY the key aligned with the partner's distribution
             # column — extra equi-keys don't participate in routing

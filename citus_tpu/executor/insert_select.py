@@ -54,8 +54,10 @@ def choose_mode(session, plan: QueryPlan, meta,
     shards = session.catalog.table_shards(meta.name)
     placement = table_placement(session.catalog, meta.name,
                                 session.n_devices)
+    bounds = tuple(session.catalog.shard_mins(meta.name))
     if root.dist.shard_count != len(shards) or \
-            root.dist.placement != placement:
+            root.dist.placement != placement or \
+            (root.dist.bounds and tuple(root.dist.bounds) != bounds):
         return "repartition"
     try:
         di = columns.index(meta.distribution_column)
@@ -64,8 +66,24 @@ def choose_mode(session, plan: QueryPlan, meta,
     if di >= len(plan.host_select):
         return "repartition"
     e, _name = plan.host_select[di]
-    if isinstance(e, ir.BCol) and e.cid in root.dist.cids:
-        return "colocated"
+    # resolve projection outputs back to their source expressions (the
+    # host_select references ProjectNode cids like "p0", while dist.cids
+    # carry relation cids like "0.k")
+    from ..planner.plan import ProjectNode
+
+    node = root
+    while isinstance(e, ir.BCol):
+        if e.cid in node.dist.cids:
+            return "colocated"
+        if isinstance(node, ProjectNode):
+            src = next((se for se, cid in node.exprs if cid == e.cid),
+                       None)
+            if src is None:
+                break
+            e = src
+            node = node.input
+            continue
+        break
     return "repartition"
 
 
@@ -83,7 +101,7 @@ def execute_insert_select(session, stmt):
                 f"columns, {len(plan.host_select)} select items")
         mode = choose_mode(session, plan, meta, columns)
         result = session.executor.execute_plan(plan, raw=True)
-        n = _write_result(session, meta, columns, result)
+        n = _write_result(session, meta, columns, result, mode)
         stats = getattr(session, "stats", None)
         if stats is not None:
             from ..stats import counters as sc
@@ -177,7 +195,22 @@ def _target_arrays(session, meta, columns, result):
     return typed, validity
 
 
-def _write_result(session, meta, columns, result) -> int:
+def _device_shard_map(session, meta):
+    """device → shard_id when each device holds EXACTLY one shard of the
+    target (the 1:1 layout where colocated writes need no hashing at
+    all); None otherwise."""
+    from ..planner.plan import table_placement
+
+    shards = session.catalog.table_shards(meta.name)
+    placement = table_placement(session.catalog, meta.name,
+                                session.n_devices)
+    if len(shards) != session.n_devices or \
+            sorted(placement) != list(range(session.n_devices)):
+        return None
+    return {dev: shards[i].shard_id for i, dev in enumerate(placement)}
+
+
+def _write_result(session, meta, columns, result, mode="repartition") -> int:
     n = result.row_count
     if n == 0:
         return 0
@@ -188,7 +221,35 @@ def _write_result(session, meta, columns, result) -> int:
     pending: list[tuple[int, dict]] = []
     table = meta.name
     try:
-        if meta.method == DistributionMethod.HASH:
+        dev_map = (_device_shard_map(session, meta)
+                   if mode == "colocated" and result.device_rows
+                   else None)
+        if dev_map is not None:
+            # COLOCATED fast path: rows are already partitioned exactly
+            # like the target (choose_mode verified shard map + bounds)
+            # and each device holds one target shard — slice the
+            # device-major result per device and write each block
+            # directly, no hash, no routing masks (the pushdown mode of
+            # insert_select_planner.c:1-60, where the write never
+            # crosses workers)
+            dist_col = meta.distribution_column
+            if not validity[dist_col].all():
+                raise IngestError(
+                    f"NULL distribution column value in {table!r}")
+            off = 0
+            for dev, cnt in enumerate(result.device_rows):
+                if cnt == 0:
+                    continue
+                sl = slice(off, off + cnt)
+                off += cnt
+                rec = session.store.append_stripe(
+                    table, dev_map[dev],
+                    {c: typed[c][sl] for c in typed},
+                    {c: validity[c][sl] for c in validity},
+                    codec=codec, level=level, chunk_rows=chunk_rows,
+                    commit=False)
+                pending.append((dev_map[dev], rec))
+        elif meta.method == DistributionMethod.HASH:
             dist_col = meta.distribution_column
             if not validity[dist_col].all():
                 raise IngestError(

@@ -70,6 +70,11 @@ class ResultSet:
     # raw mode: STRING columns hold dictionary codes for this source
     # (output name → (table, column) whose dictionary decodes them)
     decode_map: dict[str, tuple[str, str]] | None = None
+    # raw mode: surviving-row count per device, rows in device-major
+    # order — lets a colocated INSERT..SELECT slice per-device blocks
+    # without re-hashing.  None when HAVING/ORDER/LIMIT disturbed the
+    # device order.
+    device_rows: list[int] | None = None
 
     def rows(self) -> list[tuple]:
         cols = [self.columns[n] for n in self.column_names]
@@ -319,7 +324,10 @@ class Executor:
                     join_out[id(node)] = out
                     return out
                 if not node.left_keys:
-                    # cartesian: output is the full product
+                    # cartesian: output is the full product (the gathered
+                    # build side is n_dev shards wide)
+                    if node.strategy == "cartesian_gather":
+                        rcap = rcap * n_dev
                     out = _round_cap(lcap * rcap)
                 else:
                     # probe side is the left/outer side; est_expansion
@@ -383,7 +391,10 @@ class Executor:
     # ------------------------------------------------------------------
     def _host_combine(self, plan: QueryPlan, cols, nulls, valid,
                       raw: bool = False) -> ResultSet:
-        valid_np = np.asarray(valid).reshape(-1)
+        valid_2d = np.asarray(valid)
+        device_rows = (valid_2d.sum(axis=1).astype(int).tolist()
+                       if valid_2d.ndim == 2 else None)
+        valid_np = valid_2d.reshape(-1)
         flat_cols: dict[str, np.ndarray] = {}
         flat_nulls: dict[str, np.ndarray] = {}
         for cid in cols:
@@ -402,6 +413,7 @@ class Executor:
             flat_nulls = {c: a[mask] for c, a in flat_nulls.items()}
             src = ColumnSource(flat_cols, flat_nulls)
             n = int(mask.sum())
+            device_rows = None  # filtered: per-device counts are stale
 
         # select outputs
         out_cols: dict[str, object] = {}
@@ -437,6 +449,7 @@ class Executor:
         # any dtype incl. decoded strings); DESC negates codes; NULL
         # placement follows PG defaults (NULLS LAST for ASC, FIRST for DESC)
         if plan.host_order_by and n > 0:
+            device_rows = None  # re-sorted: device-major order destroyed
             order_src = ColumnSource(flat_cols, flat_nulls)
             lex_keys = []  # built primary-first, reversed for np.lexsort
             for e, desc, nulls_first in plan.host_order_by:
@@ -471,11 +484,13 @@ class Executor:
             for c in names:
                 out_cols[c] = out_cols[c][lo:hi]
                 out_nulls[c] = out_nulls[c][lo:hi]
+            device_rows = None  # sliced: per-device counts are stale
         final_n = max(0, hi - lo)
 
         if raw:
             return ResultSet(names, out_cols, final_n, dtypes=out_dtypes,
-                             null_masks=out_nulls, decode_map=decode_map)
+                             null_masks=out_nulls, decode_map=decode_map,
+                             device_rows=device_rows)
         # surface NULLs as None in object columns
         for c in names:
             if out_nulls[c].any():

@@ -29,15 +29,21 @@ _I32P = np.ctypeslib.ndpointer(dtype=np.int32, flags="C_CONTIGUOUS")
 
 def _build_and_load():
     here = os.path.dirname(os.path.abspath(__file__))
-    src = os.path.join(here, "hashdict.cpp")
+    srcs = [os.path.join(here, "hashdict.cpp"),
+            os.path.join(here, "stripecodec.cpp")]
     so = os.path.join(here, "_native.so")
-    if not os.path.exists(so) or \
-            os.path.getmtime(so) < os.path.getmtime(src):
+    if not os.path.exists(so) or any(
+            os.path.getmtime(so) < os.path.getmtime(s) for s in srcs):
         tmp = so + ".tmp"
-        subprocess.run(
-            ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", src,
-             "-o", tmp, "-lz"],
-            check=True, capture_output=True, timeout=120)
+        base = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", *srcs,
+                "-o", tmp, "-pthread", "-lz"]
+        try:
+            subprocess.run(base + ["-lzstd"], check=True,
+                           capture_output=True, timeout=120)
+        except subprocess.CalledProcessError:
+            # no libzstd on this host: zstd chunks fall back to Python
+            subprocess.run(base + ["-DNO_ZSTD"], check=True,
+                           capture_output=True, timeout=120)
         os.replace(tmp, so)
     lib = ctypes.CDLL(so)
     lib.ct_string_hash_tokens.restype = None
@@ -53,6 +59,15 @@ def _build_and_load():
     lib.ct_dict_intern.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, _I64P, _I64P, ctypes.c_int64,
         _I32P, _I64P]
+    _U8P = np.ctypeslib.ndpointer(dtype=np.uint8, flags="C_CONTIGUOUS")
+    lib.ct_decode_column.restype = ctypes.c_int64
+    lib.ct_decode_column.argtypes = [
+        ctypes.c_char_p, ctypes.c_int32, _I64P, _I64P, _I64P, _I64P,
+        ctypes.c_int64, _U8P, ctypes.c_int64, ctypes.c_int32]
+    lib.ct_decode_validity.restype = ctypes.c_int64
+    lib.ct_decode_validity.argtypes = [
+        ctypes.c_char_p, ctypes.c_int32, _I64P, _I64P, _I64P, _I64P,
+        _I64P, ctypes.c_int64, _U8P, ctypes.c_int64, ctypes.c_int32]
     return lib
 
 

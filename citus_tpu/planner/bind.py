@@ -449,11 +449,13 @@ class Binder:
         if not isinstance(lit, ast.Literal):
             raise PlanningError("EXECUTE arguments must be literals")
         const = self._bind_literal(lit)
-        # strings (dictionary-code rewrites) and NULLs stay plain
-        # constants — their translation machinery is literal-driven; the
-        # generic-plan win targets numeric/date/bool parameters
-        if const.dtype == DataType.STRING or const.value is None \
-                or isinstance(const.value, tuple):
+        # NULLs and intervals stay plain constants — their folding
+        # machinery is literal-driven.  STRING params stay generic: the
+        # raw text rides in the BParam and _bind_cmp translates it to a
+        # dictionary CODE per execution (each EXECUTE re-binds, the
+        # fingerprint excludes the value, so one compiled program serves
+        # every string argument — the local_plan_cache.c behavior)
+        if const.value is None or isinstance(const.value, tuple):
             return const
         return ir.BParam(e.index, const.dtype, const.value)
 
@@ -508,10 +510,27 @@ class Binder:
 
     def _bind_cmp(self, op: str, left: ir.BExpr, right: ir.BExpr) -> ir.BExpr:
         if DataType.STRING in (left.dtype, right.dtype):
-            # normalize: column-ish on the left, literal on the right
-            if isinstance(left, ir.BConst) and left.dtype == DataType.STRING:
+            # normalize: column-ish on the left, literal/param on the right
+            if isinstance(left, (ir.BConst, ir.BParam)) and \
+                    left.dtype == DataType.STRING and \
+                    not isinstance(right, (ir.BConst, ir.BParam)):
                 left, right = right, left
                 op = _flip_cmp(op)
+            if isinstance(right, ir.BParam) and \
+                    right.dtype == DataType.STRING:
+                # generic plan: translate the string argument to this
+                # column's dictionary code NOW but keep the node a param
+                # — the code is the program INPUT, so a different string
+                # on the next EXECUTE reuses the compiled plan
+                if op not in ("=", "<>"):
+                    # range predicates lower to a code SET (value-
+                    # dependent shape): bake for this execution
+                    codes = self._codes_where(
+                        left, _str_cmp_fn(op, str(right.value)))
+                    return ir.BInConst(left, codes)
+                code = self._code_of(left, str(right.value))
+                return ir.BCmp(op, left,
+                               ir.BParam(right.idx, DataType.STRING, code))
             if not isinstance(right, ir.BConst):
                 raise PlanningError(
                     "string-to-string column comparisons need dictionary "

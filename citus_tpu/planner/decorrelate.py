@@ -97,7 +97,9 @@ def _build_scope(from_items, columns_of: Callable[[str], Optional[frozenset]],
         if isinstance(fi, ast.TableRef):
             scope.add(fi.alias or fi.name, columns_of(fi.name))
         elif isinstance(fi, ast.SubqueryRef):
-            scope.add(fi.alias, _subquery_output_columns(fi.query))
+            scope.add(fi.alias,
+                      _subquery_output_columns(fi.query)
+                      if isinstance(fi.query, ast.Select) else None)
         elif isinstance(fi, ast.Join):
             _build_scope((fi.left, fi.right), columns_of, scope)
         else:  # unknown FROM item kind: give up on exact resolution
@@ -287,6 +289,8 @@ def _try_rewrite_conjunct(conj, outer, columns_of, kept, extra_from,
     # correlated IN ------------------------------------------------------
     if isinstance(conj, ast.InSubquery):
         sub = conj.query
+        if not isinstance(sub, ast.Select):
+            return False      # compound subquery: eager path materializes
         inner = _build_scope(sub.from_items, columns_of)
         if not (inner.exact and outer.exact) or \
                 not _is_correlated(sub, inner, outer):
@@ -304,6 +308,17 @@ def _try_rewrite_conjunct(conj, outer, columns_of, kept, extra_from,
             # the EXISTS rewrite would test every row instead
             raise UnsupportedQueryError(
                 "correlated IN with ORDER BY/LIMIT is not supported")
+        # the operand moves INTO the subquery's WHERE, where name
+        # resolution is inner-first: any operand ref the inner scope can
+        # also resolve would be silently captured (o.ck in `ck in
+        # (select lk from l ...)` turning into l.ck = l.lk) — reject
+        inner_sc = _build_scope(sub.from_items, columns_of)
+        for r in _expr_refs(conj.operand):
+            if inner_sc.resolves(r):
+                raise UnsupportedQueryError(
+                    f"correlated IN operand column {r} is ambiguous "
+                    "inside the subquery — qualify it with a table "
+                    "alias not used in the subquery")
         eq = ast.BinaryOp("=", sub.items[0].expr, conj.operand)
         new_where = _make_and(_split_and(sub.where) + [eq])
         sub2 = dc_replace(sub, where=new_where)
@@ -327,6 +342,8 @@ def _flip(op: str) -> str:
 
 def _rewrite_exists(sub: ast.Select, negated: bool, outer: _Scope,
                     columns_of, semis) -> bool:
+    if not isinstance(sub, ast.Select):
+        return False          # compound subquery: eager path materializes
     inner = _build_scope(sub.from_items, columns_of)
     if not (inner.exact and outer.exact):
         return False          # ambiguous resolution: leave for eager path
@@ -336,9 +353,11 @@ def _rewrite_exists(sub: ast.Select, negated: bool, outer: _Scope,
             ast.contains_aggregate(it.expr) for it in sub.items):
         raise UnsupportedQueryError(
             "correlated EXISTS with aggregation/CTEs is not supported")
-    if sub.limit == 0:
+    if sub.limit == 0 or sub.offset:
+        # LIMIT 0 makes EXISTS constant-false; OFFSET k demands > k
+        # matches — neither survives the match-existence rewrite
         raise UnsupportedQueryError(
-            "correlated EXISTS (... LIMIT 0) is not supported")
+            "correlated EXISTS with LIMIT 0 / OFFSET is not supported")
     # a LIMIT >= 1 inside EXISTS is semantically inert — drop it
 
     local: list[ast.Expr] = []
@@ -375,13 +394,15 @@ def _rewrite_exists(sub: ast.Select, negated: bool, outer: _Scope,
 def _rewrite_scalar_agg(lhs: ast.Expr, op: str, sub: ast.Select,
                         outer: _Scope, columns_of, kept,
                         extra_from) -> bool:
+    if not isinstance(sub, ast.Select):
+        return False          # compound subquery: eager path materializes
     inner = _build_scope(sub.from_items, columns_of)
     if not (inner.exact and outer.exact) or \
             not _is_correlated(sub, inner, outer):
         return False
     if sub.ctes or sub.group_by or sub.having is not None or \
             sub.distinct or sub.order_by or sub.limit is not None or \
-            len(sub.items) != 1:
+            sub.offset is not None or len(sub.items) != 1:
         raise UnsupportedQueryError(
             "correlated scalar subquery must be a bare aggregate")
     item = sub.items[0].expr

@@ -25,6 +25,7 @@ _JOIN_LABEL = {
     "repart_right": "Repartition Join (single: right)",
     "repart_left": "Repartition Join (single: left)",
     "repart_both": "Repartition Join (dual all_to_all)",
+    "cartesian_gather": "Cartesian Product (all_gather build)",
 }
 
 

@@ -880,8 +880,16 @@ class DistributedPlanner:
                 # falsely align with single-column hash placement
                 node.dist = self.device_dist(frozenset())
         elif strategy == "cartesian":
-            raise PlanningError(
-                "cartesian products are not supported (add a join clause)")
+            # sharded × sharded keyless product: all_gather the (smaller)
+            # build side across the mesh, then cross each device's probe
+            # shard against the full build relation.  Result keeps the
+            # probe side's distribution (build columns replicate).
+            # Reference analogue: CARTESIAN_PRODUCT join rule,
+            # multi_join_order.h:40
+            node.strategy = "cartesian_gather"
+            node.dist = Dist(left.dist.kind, frozenset(left.dist.cids),
+                             left.dist.shard_count, left.dist.placement,
+                             left.dist.bounds)
         if node.join_type != "inner" and node.dist is not None:
             # null-extended rows carry NULL partition values, so only the
             # preserved side's own partition columns survive as a reliable
@@ -899,6 +907,10 @@ class DistributedPlanner:
         node.est_expansion = self._estimate_expansion(node)
         node.est_rows = max(int(node.left.est_rows * node.est_expansion),
                             left.est_rows, right.est_rows)
+        if node.strategy == "cartesian_gather" or (
+                node.strategy == "broadcast" and not node.left_keys):
+            node.est_rows = max(1, node.left.est_rows
+                                * node.right.est_rows)
         node.out_columns = {**left.out_columns, **right.out_columns}
         self._annotate_join_keys(node)
         return node
@@ -1154,6 +1166,11 @@ class DistributedPlanner:
                 gk_cids.add(g.cid)
         if not group_keys:
             node.combine = "global"
+        elif self.n_devices == 1 and input_node.dist.kind != "replicated":
+            # a 1-device mesh already holds every row of every group: the
+            # all_to_all combine would be an identity shuffle paying full
+            # pack/unpack buffers (same rule as 1-device local joins)
+            node.combine = "local"
         elif input_node.dist.kind in ("hash", "device") and \
                 (input_node.dist.cids & gk_cids):
             node.combine = "local"  # groups already device-disjoint

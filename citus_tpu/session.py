@@ -268,6 +268,8 @@ class Session:
             if udf is not None:
                 return udf
             return self._execute_select(stmt)
+        if isinstance(stmt, ast.SetOp):
+            return self._execute_setop(stmt)
         if isinstance(stmt, ast.CreateTable):
             return self._execute_create_table(stmt)
         if isinstance(stmt, ast.AlterTable):
@@ -748,6 +750,17 @@ class Session:
         pull-to-coordinator mode only for shapes the raw path rejects."""
         from .executor.insert_select import execute_insert_select
 
+        if isinstance(stmt.query, ast.SetOp):
+            # compound source: materialize the set operation, then insert
+            # from the temp (recursive-planning route)
+            cleanup: list[str] = []
+            try:
+                sel = self._setop_select(stmt.query, cleanup, {})
+                return self._execute_insert_select(
+                    dc_replace(stmt, query=sel))
+            finally:
+                for t in cleanup:
+                    self._drop_temp(t)
         try:
             result, _mode = execute_insert_select(self, stmt)
             return result
@@ -933,9 +946,8 @@ class Session:
 
         cte_scope = dict(cte_scope or {})
         for cte in sel.ctes:
-            inner = self._recursive_plan(cte.query, cleanup, cte_scope)
-            temp = self._materialize(self._sub_params(inner), cleanup,
-                                     cte.column_names)
+            temp = self._query_to_temp(cte.query, cleanup, cte_scope,
+                                       cte.column_names)
             cte_scope[cte.name] = temp
 
         def columns_of(name: str):
@@ -974,8 +986,7 @@ class Session:
                                     fi.alias or fi.name)
             return fi
         if isinstance(fi, ast.SubqueryRef):
-            inner = self._recursive_plan(fi.query, cleanup, cte_scope)
-            temp = self._materialize(self._sub_params(inner), cleanup)
+            temp = self._query_to_temp(fi.query, cleanup, cte_scope)
             return ast.TableRef(temp, fi.alias)
         if isinstance(fi, ast.Join):
             return ast.Join(fi.join_type,
@@ -987,9 +998,20 @@ class Session:
                             fi.using_cols)
         return fi
 
+    def _subquery_select(self, q, cleanup, cte_scope) -> ast.Select:
+        """Expression-subquery body → plain Select (compound bodies
+        materialize to a temp first)."""
+        if isinstance(q, ast.SetOp):
+            temp = self._query_to_temp(q, cleanup, cte_scope)
+            return ast.Select(items=(ast.SelectItem(ast.Star()),),
+                              from_items=(ast.TableRef(temp),))
+        return q
+
     def _rewrite_expr(self, e: ast.Expr, cleanup, cte_scope) -> ast.Expr:
         if isinstance(e, ast.ScalarSubquery):
-            inner = self._recursive_plan(e.query, cleanup, cte_scope)
+            inner = self._recursive_plan(
+                self._subquery_select(e.query, cleanup, cte_scope),
+                cleanup, cte_scope)
             result = self._execute_subselect(self._sub_params(inner))
             if result.row_count > 1:
                 raise ExecutionError(
@@ -999,7 +1021,9 @@ class Session:
             dt = _result_dtype(result, 0)
             return _value_to_literal(result.rows()[0][0], dt)
         if isinstance(e, ast.InSubquery):
-            inner = self._recursive_plan(e.query, cleanup, cte_scope)
+            inner = self._recursive_plan(
+                self._subquery_select(e.query, cleanup, cte_scope),
+                cleanup, cte_scope)
             result = self._execute_subselect(self._sub_params(inner))
             dt = _result_dtype(result, 0)
             raw = [r[0] for r in result.rows()]
@@ -1020,7 +1044,9 @@ class Session:
             # (x IN (..., NULL) is TRUE or NULL, never FALSE-turned-TRUE)
             return ast.InList(operand, values, False)
         if isinstance(e, ast.Exists):
-            inner = self._recursive_plan(e.query, cleanup, cte_scope)
+            inner = self._recursive_plan(
+                self._subquery_select(e.query, cleanup, cte_scope),
+                cleanup, cte_scope)
             limited = dc_replace(self._sub_params(inner), limit=1)
             result = self._execute_subselect(limited)
             found = result.row_count > 0
@@ -1071,6 +1097,12 @@ class Session:
         """Execute a subquery and store its rows as a temp reference table
         (the intermediate-result broadcast analogue)."""
         result = self._execute_subselect(sel)
+        return self._store_result(result, cleanup, column_names)
+
+    def _store_result(self, result, cleanup: list[str],
+                      column_names: tuple[str, ...] = ()) -> str:
+        """ResultSet (or shim with column_names/columns/row_count/dtypes)
+        → temp reference table."""
         # itertools.count is GIL-atomic — concurrent query threads must
         # not mint the same intermediate-table name
         name = f"__intermediate_{next(self._temp_counter)}"
@@ -1116,6 +1148,99 @@ class Session:
                                          validity)
         return name
 
+    # -- set operations ----------------------------------------------------
+    def _execute_setop(self, stmt: "ast.SetOp"):
+        """UNION [ALL] / INTERSECT / EXCEPT via recursive materialization
+        (the reference routes set operations it cannot push down through
+        recursive planning the same way, recursive_planning.c set-op
+        handling).  Both sides land in ONE combined temp table — one
+        dictionary per string column, so no cross-dictionary code
+        translation — and the set semantics ride the existing aggregate
+        machinery: GROUP BY all columns with a side tag,
+            UNION      →  the groups themselves,
+            INTERSECT  →  HAVING min(__side) = 0 AND max(__side) = 1,
+            EXCEPT     →  HAVING max(__side) = 0.
+        SQL set-op NULL semantics (NULLs compare equal) fall out of GROUP
+        BY's NULL grouping for free."""
+        cleanup: list[str] = []
+        try:
+            final = self._setop_select(stmt, cleanup, {})
+            plan, inner_cleanup = self._plan_select(final)
+            cleanup.extend(inner_cleanup)
+            self._count_plan_shape(plan)
+            return self.executor.execute_plan(plan)
+        finally:
+            for t in cleanup:
+                self._drop_temp(t)
+
+    def _setop_select(self, stmt: "ast.SetOp", cleanup: list[str],
+                      cte_scope: dict[str, str]) -> ast.Select:
+        """SetOp tree → a plain Select over the combined temp table."""
+        cte_scope = dict(cte_scope)
+        for cte in stmt.ctes:
+            temp = self._query_to_temp(cte.query, cleanup, cte_scope,
+                                       cte.column_names)
+            cte_scope[cte.name] = temp
+        if stmt.all and stmt.op != "union":
+            raise UnsupportedQueryError(
+                f"{stmt.op.upper()} ALL is not supported (bag semantics "
+                "need per-group multiplicity matching)")
+        left = self._setop_result(stmt.left, cleanup, cte_scope)
+        right = self._setop_result(stmt.right, cleanup, cte_scope)
+        if len(left.column_names) != len(right.column_names):
+            raise PlanningError(
+                f"each {stmt.op.upper()} side must have the same number "
+                f"of columns ({len(left.column_names)} vs "
+                f"{len(right.column_names)})")
+        tag = not (stmt.op == "union" and stmt.all)
+        combined = self._store_result(
+            _concat_results(left, right, tag), cleanup)
+        names = [c for c in self.catalog.table(combined).schema.names
+                 if c != "__side"]
+        refs = tuple(ast.ColumnRef(n) for n in names)
+        items = tuple(ast.SelectItem(r, n) for r, n in zip(refs, names))
+        having = None
+        group_by: tuple = ()
+        if stmt.op == "union" and not stmt.all:
+            group_by = refs
+        elif stmt.op == "intersect":
+            group_by = refs
+            side = ast.ColumnRef("__side")
+            having = ast.BinaryOp(
+                "AND",
+                ast.BinaryOp("=", ast.FuncCall("min", (side,)),
+                             ast.Literal(0)),
+                ast.BinaryOp("=", ast.FuncCall("max", (side,)),
+                             ast.Literal(1)))
+        elif stmt.op == "except":
+            group_by = refs
+            having = ast.BinaryOp("=", ast.FuncCall(
+                "max", (ast.ColumnRef("__side"),)), ast.Literal(0))
+        return ast.Select(items=items,
+                          from_items=(ast.TableRef(combined),),
+                          group_by=group_by, having=having,
+                          order_by=stmt.order_by, limit=stmt.limit,
+                          offset=stmt.offset)
+
+    def _setop_result(self, q, cleanup: list[str], cte_scope):
+        """One set-op side → executed ResultSet."""
+        if isinstance(q, ast.SetOp):
+            return self._execute_subselect(
+                self._setop_select(q, cleanup, cte_scope))
+        inner = self._recursive_plan(q, cleanup, cte_scope)
+        return self._execute_subselect(self._sub_params(inner))
+
+    def _query_to_temp(self, q, cleanup: list[str], cte_scope,
+                       column_names: tuple[str, ...] = ()) -> str:
+        """Select | SetOp → temp reference table (CTE/derived-table
+        bodies may be compound queries)."""
+        if isinstance(q, ast.SetOp):
+            sel = self._setop_select(q, cleanup, cte_scope)
+            return self._materialize(sel, cleanup, column_names)
+        inner = self._recursive_plan(q, cleanup, cte_scope)
+        return self._materialize(self._sub_params(inner), cleanup,
+                                 column_names)
+
     def _drop_temp(self, name: str):
         try:
             self.catalog.drop_table(name)
@@ -1125,6 +1250,37 @@ class Session:
 
     def _save_catalog(self):
         self.catalog.save(os.path.join(self.data_dir, "catalog.json"))
+
+
+def _concat_results(left, right, tag: bool):
+    """Two ResultSets → one combined result (columns matched by
+    POSITION, names from the left side), plus an int __side column (0 =
+    left, 1 = right) when `tag`.  Feeds _store_result for set-operation
+    temps."""
+    from .executor.runner import ResultSet
+
+    n = left.row_count + right.row_count
+    names = list(left.column_names)
+    cols: dict[str, object] = {}
+    dtypes: dict[str, DataType] = {}
+    for lname, rname in zip(names, right.column_names):
+        lv = list(left.columns[lname])
+        rv = list(right.columns[rname])
+        cols[lname] = np.asarray(lv + rv, dtype=object)
+        ldt = _result_dtype(left, lname)
+        rdt = _result_dtype(right, rname)
+        if ldt is not None and ldt == rdt:
+            dtypes[lname] = ldt
+        elif DataType.DATE in (ldt, rdt):
+            raise PlanningError(
+                "set-operation columns mix DATE and non-DATE values")
+    if tag:
+        names.append("__side")
+        cols["__side"] = np.concatenate(
+            [np.zeros(left.row_count, dtype=np.int64),
+             np.ones(right.row_count, dtype=np.int64)])
+        dtypes["__side"] = DataType.INT64
+    return ResultSet(names, cols, n, dtypes=dtypes)
 
 
 def _result_dtype(result, col: int | str):

@@ -411,6 +411,24 @@ class Select(Statement):
 
 
 @dataclass(frozen=True)
+class SetOp(Statement):
+    """Compound query: UNION [ALL] / INTERSECT / EXCEPT.  `left`/`right`
+    are Select or nested SetOp; ORDER BY / LIMIT / OFFSET apply to the
+    combined result (SQL scoping).  INTERSECT ALL / EXCEPT ALL are
+    rejected at execution (bag semantics need per-group multiplicity
+    matching)."""
+
+    op: str                 # union | intersect | except
+    all: bool
+    left: Statement
+    right: Statement
+    order_by: tuple[OrderItem, ...] = ()
+    limit: Optional[int] = None
+    offset: Optional[int] = None
+    ctes: tuple[CommonTableExpr, ...] = ()
+
+
+@dataclass(frozen=True)
 class ColumnSpec(Node):
     name: str
     type_name: str

@@ -16,7 +16,8 @@ select from where group by having order limit offset as and or not in is null
 like between distinct case when then else end join inner left right full outer
 cross on create table drop insert into values copy with delimiter header format
 csv text exists interval date cast extract substring for if asc desc nulls
-first last set show explain analyze verbose union all true false using
+first last set show explain analyze verbose union intersect except all
+true false using
 update delete merge matched do nothing returning
 begin commit rollback abort transaction work start
 """.split())

@@ -184,7 +184,11 @@ class Parser:
         return ast.TransactionStmt("rollback")
 
     # -- SELECT ------------------------------------------------------------
-    def parse_select(self) -> ast.Select:
+    def parse_select(self):
+        """Full query expression: SELECT core, optional set operations
+        (INTERSECT binds tighter than UNION/EXCEPT, PG precedence), and
+        the trailing ORDER BY / LIMIT / OFFSET which scope to the whole
+        compound.  Returns ast.Select or ast.SetOp."""
         ctes: list[ast.CommonTableExpr] = []
         if self.accept_keyword("with"):
             while True:
@@ -203,6 +207,68 @@ class Parser:
                 ctes.append(ast.CommonTableExpr(name, sub, col_names))
                 if not self.accept_op(","):
                     break
+
+        node = self._parse_union_term()
+        while self.at_keyword("union", "except"):
+            op = self.advance().value
+            all_flag = bool(self.accept_keyword("all"))
+            if not all_flag:
+                self.accept_keyword("distinct")
+            node = ast.SetOp(op, all_flag, node, self._parse_union_term())
+
+        order_by: list[ast.OrderItem] = []
+        if self.accept_keyword("order"):
+            self.expect_keyword("by")
+            order_by.append(self.parse_order_item())
+            while self.accept_op(","):
+                order_by.append(self.parse_order_item())
+
+        limit = offset = None
+        while self.at_keyword("limit", "offset"):
+            if self.accept_keyword("limit"):
+                if self.accept_keyword("all"):
+                    limit = None
+                else:
+                    limit = self._expect_integer()
+            elif self.accept_keyword("offset"):
+                offset = self._expect_integer()
+
+        from dataclasses import replace as dc_replace
+
+        if isinstance(node, ast.SetOp):
+            return dc_replace(node, order_by=tuple(order_by), limit=limit,
+                              offset=offset, ctes=tuple(ctes))
+        if node.order_by or node.limit is not None or \
+                node.offset is not None:
+            # a parenthesized select with its own ORDER BY/LIMIT followed
+            # by more: nothing to merge (outer clauses empty ⇒ keep inner)
+            if order_by or limit is not None or offset is not None:
+                self.error("conflicting ORDER BY/LIMIT placement")
+            return dc_replace(node, ctes=tuple(ctes))
+        return dc_replace(node, order_by=tuple(order_by), limit=limit,
+                          offset=offset, ctes=tuple(ctes))
+
+    def _parse_union_term(self):
+        node = self._parse_query_primary()
+        while self.at_keyword("intersect"):
+            self.advance()
+            all_flag = bool(self.accept_keyword("all"))
+            if not all_flag:
+                self.accept_keyword("distinct")
+            node = ast.SetOp("intersect", all_flag, node,
+                             self._parse_query_primary())
+        return node
+
+    def _parse_query_primary(self):
+        if self.at_op("(") and self.peek().kind == "keyword" and \
+                self.peek().value in ("select", "with"):
+            self.expect_op("(")
+            q = self.parse_select()
+            self.expect_op(")")
+            return q
+        return self._parse_select_core()
+
+    def _parse_select_core(self) -> ast.Select:
         self.expect_keyword("select")
         distinct = False
         if self.accept_keyword("distinct"):
@@ -230,27 +296,9 @@ class Parser:
 
         having = self.parse_expr() if self.accept_keyword("having") else None
 
-        order_by: list[ast.OrderItem] = []
-        if self.accept_keyword("order"):
-            self.expect_keyword("by")
-            order_by.append(self.parse_order_item())
-            while self.accept_op(","):
-                order_by.append(self.parse_order_item())
-
-        limit = offset = None
-        while self.at_keyword("limit", "offset"):
-            if self.accept_keyword("limit"):
-                if self.accept_keyword("all"):
-                    limit = None
-                else:
-                    limit = self._expect_integer()
-            elif self.accept_keyword("offset"):
-                offset = self._expect_integer()
-
         return ast.Select(
             items=tuple(items), from_items=tuple(from_items), where=where,
-            group_by=tuple(group_by), having=having, order_by=tuple(order_by),
-            limit=limit, offset=offset, distinct=distinct, ctes=tuple(ctes))
+            group_by=tuple(group_by), having=having, distinct=distinct)
 
     def _expect_number(self) -> str:
         if self.cur.kind != "number":

@@ -92,6 +92,9 @@ class Fuzz:
     # — exercise the decorrelation path (semi/anti joins, grouped
     # derived tables)
     subqueries: list[tuple] = field(default_factory=list)
+    # set operation tail: (op, all_flag, rendered_right_select) — only in
+    # plain-select mode; ORDER BY is skipped (sides compare as multisets)
+    setop: tuple | None = None
 
     def sql(self) -> str:
         frm = self.tables[0]
@@ -111,6 +114,9 @@ class Fuzz:
             q += " group by " + ", ".join(self.group_by)
         if self.having:
             q += " having " + self.having
+        if self.setop is not None:
+            op, all_flag, right = self.setop
+            q += f" {op}{' all' if all_flag else ''} {right}"
         if self.order_limit:
             q += " " + self.order_limit
         return q
@@ -138,6 +144,22 @@ def _rand_filter(rng: random.Random, tables) -> str | None:
     if kind == "int":
         return f"{name} {op} {rng.choice(INT_POOL)}"
     return f"{name} {op} {rng.choice(FLOAT_POOL)}"
+
+
+def _pick_kind_match(rng: random.Random, table: str,
+                     kinds: list[str]) -> list[str] | None:
+    """Columns of `table` matching the kind signature, or None."""
+    out = []
+    used: set[str] = set()
+    for k in kinds:
+        opts = [c for c, ck in TABLES[table]
+                if ck == k and c not in used]
+        if not opts:
+            return None
+        c = rng.choice(opts)
+        used.add(c)
+        out.append(c)
+    return out
 
 
 def _rand_corr_subquery(rng: random.Random, tables):
@@ -240,6 +262,26 @@ def generate(rng: random.Random) -> Fuzz:
     else:  # plain projection mode
         rng.shuffle(cols)
         f.plain_select = [c for c, _ in cols[:rng.choice([1, 2, 3])]]
+        if rng.random() < 0.25 and not f.joins and not f.subqueries:
+            # set-operation tail over kind-compatible columns of another
+            # table (multiset comparison — no ORDER BY needed)
+            kinds = [k for c, k in TABLES[f.tables[0]]
+                     if c in f.plain_select]
+            others = [t for t in TABLES if t not in f.tables]
+            rng.shuffle(others)
+            for t in others:
+                match = _pick_kind_match(rng, t, kinds)
+                if match is None:
+                    continue
+                right = f"select {', '.join(match)} from {t}"
+                flt = _rand_filter(rng, [t])
+                if flt and rng.random() < 0.5:
+                    right += f" where {flt}"
+                op = rng.choice(["union", "union", "intersect", "except"])
+                f.setop = (op, op == "union" and rng.random() < 0.5,
+                           right)
+                break
+            return f
         # deterministic ORDER BY + LIMIT only when a unique key of every
         # joined table is part of the sort (total order ⇒ both engines
         # agree on which rows survive the LIMIT)
@@ -279,6 +321,8 @@ def shrink(q: Fuzz, still_fails) -> Fuzz:
         for i in range(len(q.subqueries)):
             candidates.append(replace(
                 q, subqueries=q.subqueries[:i] + q.subqueries[i + 1:]))
+        if q.setop is not None:
+            candidates.append(replace(q, setop=None))
         if q.joins:
             dropped = q.joins[-1]
             keep_tabs = [t for t in q.tables if t != dropped[2]]

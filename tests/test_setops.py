@@ -1,0 +1,105 @@
+"""Set operations (UNION / UNION ALL / INTERSECT / EXCEPT) and cartesian
+products.
+
+Reference behaviors mirrored: recursive planning of set operations
+(recursive_planning.c set-op handling — each side materializes to an
+intermediate result unless pushdownable) and the CARTESIAN_PRODUCT join
+rule (multi_join_order.h:40).  Here both sides of a set op land in ONE
+combined temp (single dictionary per string column) and the set semantics
+ride GROUP BY + HAVING over a side tag; cartesian products all_gather the
+build side across the mesh."""
+
+import pytest
+
+import citus_tpu
+from citus_tpu.errors import PlanningError, UnsupportedQueryError
+
+
+@pytest.fixture(scope="module")
+def sess(tmp_path_factory):
+    s = citus_tpu.connect(
+        data_dir=str(tmp_path_factory.mktemp("setops")),
+        n_devices=4, compute_dtype="float64")
+    s.execute("create table a (x bigint, y text)")
+    s.create_distributed_table("a", "x", shard_count=4)
+    s.execute("create table b (x bigint, y text)")
+    s.create_distributed_table("b", "x", shard_count=4)
+    s.execute("insert into a values (1,'p'),(2,'q'),(2,'q'),(3,null)")
+    s.execute("insert into b values (2,'q'),(3,null),(4,'r')")
+    return s
+
+
+class TestSetOps:
+    def test_union_all_keeps_duplicates(self, sess):
+        r = sess.execute("select x from a union all select x from b")
+        assert sorted(v for (v,) in r.rows()) == [1, 2, 2, 2, 3, 3, 4]
+
+    def test_union_dedupes(self, sess):
+        r = sess.execute("select x, y from a union select x, y from b "
+                         "order by x")
+        assert r.rows() == [(1, "p"), (2, "q"), (3, None), (4, "r")]
+
+    def test_intersect_nulls_compare_equal(self, sess):
+        # SQL set ops treat NULLs as equal (unlike WHERE equality)
+        r = sess.execute("select x, y from a intersect "
+                         "select x, y from b order by x")
+        assert r.rows() == [(2, "q"), (3, None)]
+
+    def test_except(self, sess):
+        r = sess.execute("select x, y from a except select x, y from b")
+        assert r.rows() == [(1, "p")]
+
+    def test_intersect_binds_tighter_than_union(self, sess):
+        r = sess.execute(
+            "select x from a where x > 1 intersect select x from b "
+            "union all select x from a where x = 1 order by x")
+        assert r.rows() == [(1,), (2,), (3,)]
+
+    def test_setop_as_derived_table(self, sess):
+        r = sess.execute("select count(*) from "
+                         "(select x from a union select x from b) as u")
+        assert r.rows() == [(4,)]
+
+    def test_setop_in_cte(self, sess):
+        r = sess.execute("with u as (select x from a except "
+                         "select x from b) select * from u")
+        assert r.rows() == [(1,)]
+
+    def test_setop_in_in_subquery(self, sess):
+        r = sess.execute("select x from a where x in (select x from a "
+                         "intersect select x from b) order by x")
+        assert r.rows() == [(2,), (2,), (3,)]
+
+    def test_order_limit_scope_whole_compound(self, sess):
+        r = sess.execute("select x from a union select x from b "
+                         "order by x desc limit 2")
+        assert r.rows() == [(4,), (3,)]
+
+    def test_arity_mismatch_raises(self, sess):
+        with pytest.raises(PlanningError, match="same number"):
+            sess.execute("select x, y from a union select x from b")
+
+    def test_intersect_all_rejected(self, sess):
+        with pytest.raises(UnsupportedQueryError, match="ALL"):
+            sess.execute("select x from a intersect all select x from b")
+
+    def test_union_mixed_int_float(self, sess):
+        r = sess.execute("select x from a where x = 1 "
+                         "union select x + 0.5 from b where x = 2")
+        assert sorted(v for (v,) in r.rows()) == [1.0, 2.5]
+
+
+class TestCartesian:
+    def test_cross_join_product(self, sess):
+        r = sess.execute("select count(*) from a cross join b")
+        assert r.rows() == [(12,)]
+
+    def test_comma_cartesian_with_filter(self, sess):
+        r = sess.execute("select a.x, b.x from a, b "
+                         "where a.x + b.x >= 7 order by a.x, b.x")
+        assert r.rows() == [(3, 4)]
+
+    def test_cartesian_strategy_in_plan(self, sess):
+        r = sess.execute("explain select count(*) from a, b")
+        text = "\n".join(row[0] for row in r.rows())
+        assert "Cartesian Product (all_gather build)" in text
