@@ -152,6 +152,14 @@ def main() -> None:
              "where o_custkey = l_suppkey",
              n_ord + n_li),
             ("tpch_q3_rows_per_sec", QUERIES["Q3"], n_cust + n_ord + n_li),
+            # HLL sketch build + register fold (vs the exact two-level
+            # DISTINCT split the next line measures)
+            ("approx_count_distinct_rows_per_sec",
+             "select approx_count_distinct(l_partkey) from lineitem",
+             n_li),
+            ("exact_count_distinct_rows_per_sec",
+             "select count(distinct l_partkey) from lineitem",
+             n_li),
         ]
         for name, sql, rows in configs:
             if only is not None and name not in only:

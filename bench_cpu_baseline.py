@@ -74,8 +74,11 @@ def main() -> None:
               flush=True)
 
     path = os.path.join(HERE, "BASELINE.json")
-    with open(path) as f:
-        doc = json.load(f)
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        doc = {}
     doc["cpu_baseline"] = results
     tmp = path + ".tmp"
     with open(tmp, "w") as f:

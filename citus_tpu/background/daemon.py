@@ -29,10 +29,13 @@ class MaintenanceDaemon:
         self._last_recover = 0.0
         self._last_cleanup = 0.0
         self._last_deadlock = 0.0
+        self._last_health = 0.0
         # observability: how many times each duty ran
         self.recover_runs = 0
         self.cleanup_runs = 0
         self.deadlock_checks = 0
+        self.health_sweeps = 0
+        self.nodes_disabled = 0
 
     def start(self) -> None:
         if self._thread is not None and self._thread.is_alive():
@@ -41,6 +44,7 @@ class MaintenanceDaemon:
         # already ran recovery + sweep synchronously)
         now = time.monotonic()
         self._last_recover = self._last_cleanup = self._last_deadlock = now
+        self._last_health = now
         self._stop.clear()
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="citus-tpu-maintenanced")
@@ -59,6 +63,7 @@ class MaintenanceDaemon:
                 self._maybe_recover(now)
                 self._maybe_cleanup(now)
                 self._maybe_deadlock_check(now)
+                self._maybe_health_sweep(now)
             except Exception:
                 # the daemon must survive transient errors (the reference
                 # daemon catches and retries on its next wakeup)
@@ -75,6 +80,21 @@ class MaintenanceDaemon:
         self._last_recover = now
         self.session.txn_manager.recover()
         self.recover_runs += 1
+
+    def _maybe_health_sweep(self, now: float) -> None:
+        """Node-death DETECTION (health_check.c analogue): probe every
+        node; failures get disabled so reads fail over to replicas.
+        Promotion (making the failover durable) stays operator-issued
+        via citus_promote_node."""
+        iv = self._interval("health_check_interval_ms")
+        if iv is None or now - self._last_health < iv:
+            return
+        self._last_health = now
+        from ..operations.health import health_sweep
+
+        disabled = health_sweep(self.session)
+        self.health_sweeps += 1
+        self.nodes_disabled += len(disabled)
 
     def _maybe_cleanup(self, now: float) -> None:
         iv = self._interval("defer_shard_delete_interval_ms")

@@ -105,7 +105,13 @@ class ChangeLog:
         """Append a commit's worth of events: one write + fsync."""
         if not self.enabled or self.suppressed or not events:
             return
+        from ..utils.faultinjection import fault_point
+
         with self._mu:
+            # named seam: a crash before the journal append must lose at
+            # most the in-flight commit's events (at-most-once window),
+            # never corrupt earlier lines
+            fault_point("cdc.append")
             now = time.time()
             payload = []
             for ev in events:

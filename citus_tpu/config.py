@@ -161,6 +161,14 @@ _register(ConfigVar(
     "defer_shard_delete_interval_ms", 15_000,
     "Deferred cleanup sweep interval (ref: citus.defer_shard_delete_interval).",
     int, min_value=-1, max_value=86_400_000))
+_register(ConfigVar(
+    "health_check_interval_ms", -1,
+    "Maintenance-daemon node health sweep: probe every node (device + "
+    "storage) and disable failures so reads fail over to replicas; -1 "
+    "disables (ref: operations/health_check.c). Off by default — probes "
+    "pay a device round trip per node, expensive on remote-attached "
+    "meshes.",
+    int, min_value=-1, max_value=86_400_000))
 
 # --- rebalancer (ref: shard_rebalancer.c + pg_dist_rebalance_strategy) ----
 _register(ConfigVar(

@@ -187,6 +187,24 @@ def evaluate(e: ir.BExpr, src: ColumnSource, xp):
             old_null = (xp.zeros((), dtype=bool) if nmask is None else nmask)
             nmask = xp.where(take, new_null, old_null)
         return out, nmask
+    if isinstance(e, ir.BMath):
+        v, nmask = evaluate(e.operand, src, xp)
+        v = v.astype(_dt(e.dtype, xp))
+        if e.op == "exp2neg":
+            return xp.exp2(-v), nmask
+        if e.op == "ln":
+            return xp.log(v), nmask
+        raise ExecutionError(f"bad math op {e.op}")
+    if isinstance(e, (ir.BHllBucket, ir.BHllRho)):
+        v, nmask = evaluate(e.operand, src, xp)
+        h = _hash32(v, xp)
+        if isinstance(e, ir.BHllBucket):
+            out = (h >> np.uint32(32 - e.p)).astype(np.int32)
+            return out, nmask
+        w = (h << np.uint32(e.p)).astype(np.uint32)
+        rho = _clz32(w, xp) + 1
+        cap = 32 - e.p + 1
+        return xp.minimum(rho, cap).astype(np.int32), nmask
     if isinstance(e, ir.BStrRemap):
         v, nmask = evaluate(e.operand, src, xp)
         m = len(e.lut)
@@ -210,6 +228,30 @@ def evaluate(e: ir.BExpr, src: ColumnSource, xp):
         raise ExecutionError(
             "aggregate reached the scalar evaluator (planner bug)")
     raise ExecutionError(f"unsupported expression node {type(e).__name__}")
+
+
+def _hash32(v, xp):
+    """32-bit murmur-finalizer hash of an int/code column (the HLL input;
+    same fmix32 as shard routing, both backends bit-identical)."""
+    if xp is np:
+        from ..catalog.distribution import hash_token
+
+        return hash_token(np.asarray(v)).view(np.uint32)
+    from ..ops.hashing import hash_token_jax
+
+    return hash_token_jax(v).view(xp.uint32)
+
+
+def _clz32(w, xp):
+    """Count leading zeros of uint32 (clz(0) = 32)."""
+    if xp is np:
+        w64 = w.astype(np.uint64)
+        # bit_length via exact float64 log2 (exact for < 2^53)
+        bitlen = np.ceil(np.log2(w64.astype(np.float64) + 1.0))
+        return (32 - bitlen).astype(np.int32)
+    import jax
+
+    return jax.lax.clz(w.astype(xp.uint32)).astype(xp.int32)
 
 
 def predicate_mask(e: ir.BExpr, src: ColumnSource, xp):

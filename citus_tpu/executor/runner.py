@@ -196,6 +196,12 @@ class Executor:
                         self._caps_to_order(plan, caps)
                 return packed, out_meta, caps, retries
             retries += 1
+            from ..utils.faultinjection import fault_point
+
+            # named seam: a failure while growing capacities must leave
+            # the plan cache / capacity memo consistent (the retry loop
+            # is the count-then-emit recovery path)
+            fault_point("executor.overflow_retry")
             if retries >= MAX_RETRIES:
                 raise CapacityOverflowError(
                     f"buffer overflow persisted after {retries} retries "

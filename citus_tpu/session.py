@@ -54,6 +54,7 @@ _UDFS = ("create_distributed_table", "create_reference_table",
          "citus_rebalance_start", "citus_rebalance_wait",
          "citus_job_wait", "citus_job_cancel", "citus_job_list",
          "citus_change_feed", "citus_create_restore_point",
+         "citus_check_cluster_node_health", "citus_promote_node",
          "citus_tables", "citus_shards")
 
 
@@ -410,6 +411,25 @@ class Session:
                  "description": [j.description for j in jobs],
                  "status": [j.status.value for j in jobs],
                  "tasks": [len(j.tasks) for j in jobs]}, len(jobs))
+        elif e.name == "citus_check_cluster_node_health":
+            # health_check.c analogue: one probe row per node (device +
+            # storage reachability from the controller)
+            from .operations.health import check_cluster_health
+
+            rows = check_cluster_health(self)
+            return ResultSet(
+                ["node_name", "is_active", "healthy"],
+                {"node_name": [r[0] for r in rows],
+                 "is_active": [r[1] for r in rows],
+                 "healthy": [r[2] for r in rows]}, len(rows))
+        elif e.name == "citus_promote_node":
+            # node_promotion.c analogue: demote a dead node's placements
+            # so every shard's surviving replica becomes its primary
+            from .operations.health import promote_node_replicas
+
+            n = promote_node_replicas(self, str(args[0]))
+            return ResultSet(["placements_demoted"],
+                             {"placements_demoted": [n]}, 1)
         elif e.name == "citus_get_node_clock":
             from .transaction.clock import global_clock
 
@@ -958,6 +978,36 @@ class Session:
                 c.name for c in self.catalog.table(name).schema.columns)
 
         sel = decorrelate_select(sel, columns_of)
+        sel = self._rewrite_approx_percentile(sel, cleanup, cte_scope)
+        from .planner.decorrelate import rewrite_multi_distinct
+
+        def column_nullable(ref: ast.ColumnRef):
+            """Can this plain column ref hold NULLs?  Schema nullability
+            refined by the EXACT manifest null-count rollup (a nullable
+            column whose committed data has zero NULLs is safe to join
+            on).  None = unresolvable/ambiguous."""
+            found = None
+            for fi in sel.from_items:
+                if not isinstance(fi, ast.TableRef):
+                    continue
+                name = cte_scope.get(fi.name, fi.name)
+                if ref.table is not None and \
+                        (fi.alias or fi.name) != ref.table:
+                    continue
+                if not self.catalog.has_table(name):
+                    continue
+                schema = self.catalog.table(name).schema
+                if schema.has_column(ref.name):
+                    if found is not None:
+                        return None  # ambiguous
+                    nullable = schema.column(ref.name).nullable
+                    if nullable:
+                        has = self.store.column_has_nulls(name, ref.name)
+                        nullable = True if has is None else has
+                    found = nullable
+            return found
+
+        sel = rewrite_multi_distinct(sel, column_nullable)
         new_from = tuple(self._rewrite_from(fi, cleanup, cte_scope)
                          for fi in sel.from_items)
         rewrite = lambda e: self._rewrite_expr(e, cleanup, cte_scope)  # noqa: E731
@@ -997,6 +1047,119 @@ class Session:
                              if fi.condition is not None else None),
                             fi.using_cols)
         return fi
+
+    def _rewrite_approx_percentile(self, sel: ast.Select, cleanup,
+                                   cte_scope) -> ast.Select:
+        """Global approx_percentile(col, q) → bounded-histogram pre-pass.
+
+        The device runs `group by value_bucket → count(*)` over the same
+        FROM/WHERE (bucket bounds come from EXACT manifest min/max
+        statistics), the host interpolates the quantile from the
+        cumulative histogram (ops/sketches.py), and the call site gets
+        the value as a constant wrapped in max() so aggregate shape is
+        preserved (one row, NULL over an empty input).  Reference:
+        percentile→tdigest rewrite, multi_logical_optimizer.c:286.
+        Grouped approx_percentile is rejected (binder raises)."""
+        from .planner.decorrelate import _map_children
+        from .ops.sketches import (
+            histogram_quantile,
+            percentile_bucket_params,
+        )
+
+        calls = [n for it in sel.items for n in ast.walk_expr(it.expr)
+                 if isinstance(n, ast.FuncCall)
+                 and n.name == "approx_percentile"]
+        if not calls:
+            return sel
+        if sel.group_by or sel.distinct:
+            raise UnsupportedQueryError(
+                "approx_percentile is supported only as a global "
+                "aggregate (no GROUP BY)")
+        N_BUCKETS = 8192
+        repl: dict[ast.FuncCall, ast.Expr] = {}
+        for call in calls:
+            if call in repl:
+                continue
+            if call.window is not None or call.distinct or \
+                    len(call.args) != 2:
+                raise UnsupportedQueryError(
+                    "approx_percentile(column, quantile) expects two "
+                    "arguments")
+            col, qlit = call.args
+            if not (isinstance(qlit, ast.Literal)
+                    and isinstance(qlit.value, (int, float))
+                    and 0.0 <= float(qlit.value) <= 1.0):
+                raise UnsupportedQueryError(
+                    "approx_percentile quantile must be a literal in "
+                    "[0, 1]")
+            if not isinstance(col, ast.ColumnRef):
+                raise UnsupportedQueryError(
+                    "approx_percentile argument must be a plain column")
+            rng = self._column_range_for(col, sel, cte_scope)
+            if rng is None:
+                raise UnsupportedQueryError(
+                    f"approx_percentile: no min/max statistics for "
+                    f"{col}")
+            lo, width = percentile_bucket_params(rng[0], rng[1],
+                                                 N_BUCKETS)
+            # bucket = clip(int((col - lo) / width), 0, B-1)
+            bucket = ast.Cast(
+                ast.BinaryOp("/",
+                             ast.BinaryOp("-", col, ast.Literal(lo)),
+                             ast.Literal(width)), "bigint")
+            bucket = ast.CaseWhen(
+                ((ast.BinaryOp(">=", bucket,
+                               ast.Literal(N_BUCKETS)),
+                  ast.Literal(N_BUCKETS - 1)),),
+                bucket)
+            hist = ast.Select(
+                items=(ast.SelectItem(bucket, "hb"),
+                       ast.SelectItem(
+                           ast.FuncCall("count", (), star=True), "c")),
+                from_items=sel.from_items, where=sel.where,
+                group_by=(bucket,),
+                # decorrelated EXISTS filters must apply here too
+                semi_joins=sel.semi_joins)
+            inner = self._recursive_plan(hist, cleanup, cte_scope)
+            result = self._execute_subselect(self._sub_params(inner))
+            # NULL column values form a NULL bucket group: percentile
+            # ignores NULLs (PG semantics), so drop it
+            rows = [r for r in result.rows() if r[0] is not None]
+            value = histogram_quantile(
+                np.asarray([r[0] for r in rows], dtype=np.int64),
+                np.asarray([r[1] for r in rows], dtype=np.int64),
+                float(qlit.value), lo, width, N_BUCKETS)
+            repl[call] = ast.FuncCall("max", (ast.Literal(value),))
+
+        def sub(e: ast.Expr) -> ast.Expr:
+            if isinstance(e, ast.FuncCall) and e in repl:
+                return repl[e]
+            return _map_children(e, sub)
+
+        return dc_replace(sel, items=tuple(
+            ast.SelectItem(sub(it.expr), it.alias) for it in sel.items))
+
+    def _column_range_for(self, ref: ast.ColumnRef, sel: ast.Select,
+                          cte_scope) -> tuple[float, float] | None:
+        """(min, max) of a plain column over sel's FROM tables, from
+        manifest statistics (exact for committed data)."""
+        for fi in sel.from_items:
+            if not isinstance(fi, ast.TableRef):
+                continue
+            if ref.table is not None and \
+                    (fi.alias or fi.name) != ref.table:
+                continue
+            name = cte_scope.get(fi.name, fi.name)
+            if not self.catalog.has_table(name):
+                continue
+            schema = self.catalog.table(name).schema
+            if not schema.has_column(ref.name):
+                continue
+            rng = self.store.column_range(name, ref.name)
+            if rng is None:
+                return None
+            return float(rng[0]), float(rng[1])
+        return None
 
     def _subquery_select(self, q, cleanup, cte_scope) -> ast.Select:
         """Expression-subquery body → plain Select (compound bodies

@@ -240,7 +240,9 @@ class Exists(Expr):
         return f"{neg}EXISTS (<subquery>)"
 
 
-AGGREGATE_FUNCS = frozenset({"count", "sum", "avg", "min", "max"})
+AGGREGATE_FUNCS = frozenset({"count", "sum", "avg", "min", "max",
+                             "approx_count_distinct",
+                             "approx_percentile"})
 
 
 def is_aggregate_call(e: Expr) -> bool:

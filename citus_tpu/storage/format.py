@@ -272,6 +272,10 @@ class StripeReader:
                  for c in columns}
         return out_v, out_m, rows_read
 
+    # codec ids the native library reported unsupported (-DNO_ZSTD
+    # builds): skip the doomed task-list + thread spawn on every read
+    _native_unsupported: set = set()
+
     def _read_native(self, columns: list[str], chunks: list[int],
                      cid: int):
         """C++ decode of the selected chunks, or None (caller falls back).
@@ -280,7 +284,8 @@ class StripeReader:
         from ..native import get_lib
 
         lib = get_lib()
-        if lib is None or not chunks:
+        if lib is None or not chunks or \
+                cid in StripeReader._native_unsupported:
             return None
         chunk_rows = self.footer["chunk_rows"]
         rows = np.asarray([chunk_rows[i] for i in chunks], dtype=np.int64)
@@ -304,6 +309,8 @@ class StripeReader:
                 row_off * itemsize, len(chunks),
                 arr.view(np.uint8), total * itemsize, np.int32(0))
             if rc != 0:
+                if rc == -5:  # codec not compiled in: never retry it
+                    StripeReader._native_unsupported.add(cid)
                 return None
             noff = np.asarray([c["noff"] for c in ch], dtype=np.int64)
             nclen = np.asarray([c["nclen"] for c in ch], dtype=np.int64)

@@ -455,6 +455,26 @@ class TableStore:
         man = self.manifest(table)
         return sum(r["bytes"] for r in man["shards"].get(str(shard_id), []))
 
+    def column_has_nulls(self, table: str, column: str) -> bool | None:
+        """Whether any committed/staged stripe holds a NULL in `column`
+        (manifest null-count rollup; None = unknown — pre-null-count
+        manifests or no stats).  Conservative under deletes: a deleted
+        NULL still counts."""
+        column = self.storage_column_name(table, column)
+        man = self.manifest(table)
+        rec_lists = list(man["shards"].values())
+        if self.overlay is not None:
+            rec_lists.extend(recs for (t, _sid), recs
+                             in self.overlay.records.items() if t == table)
+        for recs in rec_lists:
+            for r in recs:
+                s = (r.get("stats") or {}).get(column)
+                if s is None or len(s) < 3:
+                    return None
+                if s[2] > 0:
+                    return True
+        return False
+
     def column_range(self, table: str,
                      column: str) -> tuple[float, float] | None:
         """Table-wide (min, max) for a numeric/date column from manifest

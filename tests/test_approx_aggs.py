@@ -1,0 +1,171 @@
+"""Approximate aggregates: approx_count_distinct (HyperLogLog over the
+aggregate split) and approx_percentile (bounded histogram), plus the
+lifted multiple-DISTINCT-aggregate limitation.
+
+Reference: planner/multi_logical_optimizer.c:286 rewrites
+count(distinct)→hll and percentile→tdigest worker/coordinator pairs;
+here the sketches ARE grouped aggregates (registers = groups), so they
+ride the same device machinery — see citus_tpu/ops/sketches.py."""
+
+import numpy as np
+import pytest
+
+import citus_tpu
+
+
+@pytest.fixture(scope="module")
+def sess(tmp_path_factory):
+    s = citus_tpu.connect(
+        data_dir=str(tmp_path_factory.mktemp("approx")),
+        n_devices=4, compute_dtype="float64")
+    s.execute("create table ev (k bigint, g bigint, u bigint, "
+              "w bigint, x double precision)")
+    s.create_distributed_table("ev", "k", shard_count=4)
+    rng = np.random.default_rng(11)
+    n = 20_000
+    ks = np.arange(n)
+    gs = ks % 4
+    us = rng.integers(0, 3_000, n)        # ~2.9k distinct expected
+    ws = rng.integers(0, 40, n)
+    xs = rng.uniform(0.0, 1000.0, n)
+    rows = ",".join(f"({k},{g},{u},{w},{x:.4f})"
+                    for k, g, u, w, x in zip(ks, gs, us, ws, xs))
+    s.execute(f"insert into ev values {rows}")
+    yield s, {"k": ks, "g": gs, "u": us, "w": ws, "x": xs}
+    s.close()
+
+
+class TestApproxCountDistinct:
+    def test_global(self, sess):
+        s, d = sess
+        got = s.execute(
+            "select approx_count_distinct(u) from ev").rows()[0][0]
+        exact = len(np.unique(d["u"]))
+        assert abs(got - exact) <= 0.06 * exact, (got, exact)
+
+    def test_grouped(self, sess):
+        s, d = sess
+        r = s.execute("select g, approx_count_distinct(u) from ev "
+                      "group by g order by g")
+        for g, got in r.rows():
+            exact = len(np.unique(d["u"][d["g"] == g]))
+            assert abs(got - exact) <= 0.08 * exact, (g, got, exact)
+
+    def test_mixed_with_plain_aggs(self, sess):
+        s, d = sess
+        r = s.execute("select g, count(*), approx_count_distinct(w), "
+                      "sum(w) from ev group by g order by g")
+        for g, cnt, acd, sw in r.rows():
+            m = d["g"] == g
+            assert cnt == int(m.sum())
+            assert sw == int(d["w"][m].sum())
+            exact = len(np.unique(d["w"][m]))
+            assert abs(acd - exact) <= max(2, 0.1 * exact), (g, acd, exact)
+
+    def test_small_cardinality_is_near_exact(self, sess):
+        s, d = sess
+        got = s.execute(
+            "select approx_count_distinct(g) from ev").rows()[0][0]
+        assert got == 4  # linear-counting range: tiny sets come out exact
+
+    def test_with_where(self, sess):
+        s, d = sess
+        got = s.execute("select approx_count_distinct(u) from ev "
+                        "where w < 10").rows()[0][0]
+        exact = len(np.unique(d["u"][d["w"] < 10]))
+        assert abs(got - exact) <= 0.06 * exact, (got, exact)
+
+
+class TestApproxPercentile:
+    def test_median(self, sess):
+        s, d = sess
+        got = s.execute("select approx_percentile(x, 0.5) from ev"
+                        ).rows()[0][0]
+        exact = float(np.quantile(d["x"], 0.5))
+        assert abs(got - exact) <= 0.01 * 1000.0, (got, exact)
+
+    def test_tail_quantile_with_filter(self, sess):
+        s, d = sess
+        got = s.execute("select approx_percentile(x, 0.95) from ev "
+                        "where g = 1").rows()[0][0]
+        exact = float(np.quantile(d["x"][d["g"] == 1], 0.95))
+        assert abs(got - exact) <= 0.01 * 1000.0, (got, exact)
+
+    def test_alongside_other_aggs(self, sess):
+        s, d = sess
+        r = s.execute("select count(*), approx_percentile(w, 0.5) "
+                      "from ev").rows()[0]
+        assert r[0] == len(d["k"])
+        assert abs(r[1] - float(np.quantile(d["w"], 0.5))) <= 2.0
+
+    def test_grouped_percentile_unsupported(self, sess):
+        s, _ = sess
+        from citus_tpu.errors import UnsupportedQueryError
+
+        with pytest.raises(UnsupportedQueryError):
+            s.execute("select g, approx_percentile(x, 0.5) from ev "
+                      "group by g")
+
+
+class TestMultipleDistinct:
+    def test_two_distinct_args_global(self, sess):
+        s, d = sess
+        r = s.execute("select count(distinct u), count(distinct w) "
+                      "from ev").rows()[0]
+        assert r == (len(np.unique(d["u"])), len(np.unique(d["w"])))
+
+    def test_two_distinct_args_grouped(self, sess):
+        s, d = sess
+        r = s.execute("select g, count(distinct u), count(distinct w) "
+                      "from ev group by g order by g")
+        for g, cu, cw in r.rows():
+            m = d["g"] == g
+            assert cu == len(np.unique(d["u"][m]))
+            assert cw == len(np.unique(d["w"][m]))
+
+    def test_distinct_mix_with_plain(self, sess):
+        s, d = sess
+        r = s.execute("select count(distinct u), sum(w), "
+                      "count(distinct w) from ev").rows()[0]
+        assert r == (len(np.unique(d["u"])), int(d["w"].sum()),
+                     len(np.unique(d["w"])))
+
+
+class TestSemiJoinInteraction:
+    """Round-4 review regressions: rewrites that copy FROM/WHERE must
+    also carry the semi_joins decorrelation produces (dropping them
+    silently unfiltered the derived subqueries)."""
+
+    @pytest.fixture()
+    def tiny(self, tmp_path):
+        s = citus_tpu.connect(data_dir=str(tmp_path / "d"), n_devices=4,
+                              compute_dtype="float64")
+        s.execute("create table t (k bigint, a bigint, b bigint, "
+                  "v double precision)")
+        s.create_distributed_table("t", "k", shard_count=4)
+        s.execute("create table f (k bigint)")
+        s.create_distributed_table("f", "k", shard_count=4)
+        s.execute("insert into t values (1,1,10,1.0),(2,1,20,2.0),"
+                  "(3,2,30,3.0),(4,3,40,4.0)")
+        s.execute("insert into f values (1),(2)")
+        yield s
+        s.close()
+
+    def test_multi_distinct_under_exists(self, tiny):
+        r = tiny.execute(
+            "select count(distinct a), count(distinct b) from t "
+            "where exists (select 1 from f where f.k = t.k)").rows()[0]
+        assert r == (1, 2)
+
+    def test_percentile_under_exists(self, tiny):
+        r = tiny.execute(
+            "select approx_percentile(v, 1.0) from t "
+            "where exists (select 1 from f where f.k = t.k)").rows()[0][0]
+        assert abs(r - 2.0) < 0.05
+
+    def test_percentile_ignores_nulls(self, tiny):
+        tiny.execute("insert into t values (5, 9, 90, null)")
+        r = tiny.execute(
+            "select approx_percentile(v, 0.5) from t").rows()[0][0]
+        # NULL excluded; histogram quantile is the mass-point answer
+        assert 0.9 <= r <= 3.1

@@ -1,0 +1,110 @@
+"""Node health checks + promotion (VERDICT r3 missing #5; reference:
+operations/health_check.c, operations/node_promotion.c)."""
+
+import time
+
+import pytest
+
+import citus_tpu
+from citus_tpu.errors import CatalogError
+from citus_tpu.operations import health
+
+
+@pytest.fixture()
+def sess(tmp_data_dir):
+    s = citus_tpu.connect(data_dir=tmp_data_dir, n_devices=2,
+                          shard_replication_factor=2)
+    s.execute("SELECT citus_add_node('replica:1')")
+    s.execute("CREATE TABLE t (id INT, v INT)")
+    s.execute("SELECT create_distributed_table('t', 'id', 4)")
+    s.execute("INSERT INTO t VALUES " + ", ".join(
+        f"({i}, {i})" for i in range(40)))
+    yield s
+    s.close()
+
+
+class TestHealthCheck:
+    def test_all_nodes_healthy(self, sess):
+        r = sess.execute("SELECT citus_check_cluster_node_health()")
+        rows = r.rows()
+        assert len(rows) == len(sess.catalog.nodes)
+        assert all(healthy for _n, _a, healthy in rows)
+
+    def test_probe_detects_missing_device(self, sess):
+        # a device-backed node beyond the mesh probes unhealthy
+        sess.catalog.add_node("device:99")
+        names = {n: h for n, _a, h in health.check_cluster_health(sess)}
+        assert names["device:99"] is False
+        assert names["device:0"] is True
+
+    def test_health_sweep_disables_dead_node(self, sess):
+        sess.catalog.add_node("device:99")
+        disabled = health.health_sweep(sess)
+        assert "device:99" in disabled
+        assert not sess.catalog.node_by_name("device:99").is_active
+        # sweep is idempotent: already-inactive nodes stay untouched
+        assert health.health_sweep(sess) == []
+
+    def test_daemon_runs_sweeps(self, tmp_data_dir):
+        s = citus_tpu.connect(data_dir=tmp_data_dir, n_devices=1,
+                              health_check_interval_ms=50)
+        try:
+            s.catalog.add_node("device:99")
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                if s.maintenance.health_sweeps > 0 and \
+                        not s.catalog.node_by_name("device:99").is_active:
+                    break
+                time.sleep(0.05)
+            assert s.maintenance.health_sweeps > 0
+            assert not s.catalog.node_by_name("device:99").is_active
+        finally:
+            s.close()
+
+
+class TestPromotion:
+    def test_promote_dead_node(self, sess):
+        # kill the replica node, promote: its placements demote and
+        # every shard keeps exactly one active primary elsewhere
+        sess.execute("SELECT citus_disable_node('replica:1')")
+        node = sess.catalog.node_by_name("replica:1")
+        before = [p for p in sess.catalog.placements.values()
+                  if p.node_id == node.node_id
+                  and p.shard_state == "active"]
+        assert before  # replication put placements there
+        r = sess.execute("SELECT citus_promote_node('replica:1')")
+        assert int(r.rows()[0][0]) == len(before)
+        for p in before:
+            assert p.shard_state == "to_delete"
+        # reads still answer, now independent of the dead node
+        assert int(sess.execute(
+            "SELECT count(*) FROM t").rows()[0][0]) == 40
+        for s in sess.catalog.table_shards("t"):
+            assert sess.catalog.active_placement(
+                s.shard_id).node_id != node.node_id
+
+    def test_promotion_refuses_to_orphan_shards(self, tmp_data_dir):
+        s = citus_tpu.connect(data_dir=tmp_data_dir, n_devices=1)
+        try:
+            s.execute("CREATE TABLE t (id INT)")
+            s.execute("SELECT create_distributed_table('t', 'id', 2)")
+            # replication_factor 1: the only placements live on device:0
+            with pytest.raises(CatalogError, match="no replica"):
+                health.promote_node_replicas(s, "device:0")
+        finally:
+            s.close()
+
+    def test_promotion_survives_restart(self, sess, tmp_data_dir):
+        sess.execute("SELECT citus_disable_node('replica:1')")
+        sess.execute("SELECT citus_promote_node('replica:1')")
+        sess.close()
+        s2 = citus_tpu.connect(data_dir=tmp_data_dir, n_devices=2)
+        try:
+            node = s2.catalog.node_by_name("replica:1")
+            assert all(p.shard_state != "active"
+                       for p in s2.catalog.placements.values()
+                       if p.node_id == node.node_id)
+            assert int(s2.execute(
+                "SELECT count(*) FROM t").rows()[0][0]) == 40
+        finally:
+            s2.close()
