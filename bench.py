@@ -31,7 +31,8 @@ prints.
 Env knobs: BENCH_SF (default 1.0), BENCH_REPEATS (default 3),
 BENCH_ONLY (comma list of config names), BENCH_SF10 (default 0; 1
 enables the SF10 section), BENCH_SF10_SCALE (default 10.0),
-BENCH_BUDGET (default 1200 s).
+BENCH_EXTRAS (default 0; 1 adds approx/exact count-distinct and
+INSERT..SELECT mode configs), BENCH_BUDGET (default 1200 s).
 """
 
 from __future__ import annotations
@@ -85,6 +86,7 @@ def main() -> None:
     repeats = int(os.environ.get("BENCH_REPEATS", "3"))
     sf10 = os.environ.get("BENCH_SF10", "0") not in ("0", "false", "")
     sf10_scale = float(os.environ.get("BENCH_SF10_SCALE", "10.0"))
+    extras = os.environ.get("BENCH_EXTRAS", "0") not in ("0", "false", "")
     budget = float(os.environ.get("BENCH_BUDGET", "1200"))
     t_start = time.perf_counter()
     only = os.environ.get("BENCH_ONLY")
@@ -152,15 +154,22 @@ def main() -> None:
              "where o_custkey = l_suppkey",
              n_ord + n_li),
             ("tpch_q3_rows_per_sec", QUERIES["Q3"], n_cust + n_ord + n_li),
-            # HLL sketch build + register fold (vs the exact two-level
-            # DISTINCT split the next line measures)
-            ("approx_count_distinct_rows_per_sec",
-             "select approx_count_distinct(l_partkey) from lineitem",
-             n_li),
-            ("exact_count_distinct_rows_per_sec",
-             "select count(distinct l_partkey) from lineitem",
-             n_li),
         ]
+        distinct_extras = {"approx_count_distinct_rows_per_sec",
+                           "exact_count_distinct_rows_per_sec"}
+        if extras or (only is not None and only & distinct_extras):
+            # HLL sketch build + register fold (vs the exact two-level
+            # DISTINCT split the next line measures).  Opt-in: remote
+            # compiles of these programs cost minutes on tunnel-attached
+            # chips, and the driver run must stay inside its budget
+            configs += [
+                ("approx_count_distinct_rows_per_sec",
+                 "select approx_count_distinct(l_partkey) from lineitem",
+                 n_li),
+                ("exact_count_distinct_rows_per_sec",
+                 "select count(distinct l_partkey) from lineitem",
+                 n_li),
+            ]
         for name, sql, rows in configs:
             if only is not None and name not in only:
                 continue
@@ -180,7 +189,8 @@ def main() -> None:
         #    per-device blocks directly, no hash routing) ----------------
         is_wanted = {"insert_select_colocated_rows_per_sec",
                      "insert_select_repartition_rows_per_sec"}
-        is_run = is_wanted if only is None else is_wanted & only
+        is_run = ((is_wanted if extras else set())
+                  if only is None else is_wanted & only)
         if is_run and over_budget(0.75):
             print("# budget: skipping INSERT..SELECT section",
                   file=sys.stderr)

@@ -150,6 +150,9 @@ class Catalog:
         self.placements: dict[int, ShardPlacement] = {}
         self.nodes: dict[int, NodeMetadata] = {}
         self.colocation_groups: dict[int, ColocationGroup] = {}
+        # name → {"next": int, "increment": int} (pg_dist_object-propagated
+        # sequences analogue; single-controller, so no per-node ranges)
+        self.sequences: dict[str, dict] = {}
         self.version = 0
         self._next_shard_id = 102008   # reference shard ids start ~102008
         self._next_placement_id = 1
@@ -196,6 +199,60 @@ class Catalog:
                         self._next_placement_id += 1
             self._bump()
             return node
+
+    # -- sequences ---------------------------------------------------------
+    def create_sequence(self, name: str, start: int = 1,
+                        increment: int = 1) -> None:
+        """CREATE SEQUENCE analogue (the reference propagates sequences
+        to workers and hands out per-node ranges,
+        commands/sequence.c:1-40; one controller needs one counter)."""
+        with self._lock:
+            if name in self.sequences or name in self.tables:
+                raise CatalogError(f"relation {name!r} already exists")
+            if increment == 0:
+                raise CatalogError("sequence increment must be nonzero")
+            self.sequences[name] = {"next": int(start),
+                                    "increment": int(increment),
+                                    "last": None}
+            self._bump()
+
+    def drop_sequence(self, name: str, if_exists: bool = False) -> None:
+        with self._lock:
+            if name not in self.sequences:
+                if if_exists:
+                    return
+                raise CatalogError(f"sequence {name!r} does not exist")
+            del self.sequences[name]
+            self._bump()
+
+    def sequence_nextval(self, name: str,
+                         count: int = 1) -> tuple[int, int]:
+        """Allocate `count` consecutive values; returns (first,
+        increment) — one atomic locked operation so callers never read
+        the sequence dict unlocked.  Like PG, allocation is
+        non-transactional (gaps on rollback)."""
+        with self._lock:
+            seq = self.sequences.get(name)
+            if seq is None:
+                raise CatalogError(f"sequence {name!r} does not exist")
+            first = seq["next"]
+            inc = seq["increment"]
+            seq["next"] = first + inc * count
+            seq["last"] = first + inc * (count - 1)
+            self._bump()
+            return first, inc
+
+    def sequence_currval(self, name: str) -> int:
+        with self._lock:
+            seq = self.sequences.get(name)
+            if seq is None:
+                raise CatalogError(f"sequence {name!r} does not exist")
+            if seq.get("last") is None:
+                # PG parity: currval before any nextval is an error, not
+                # a never-allocated value
+                raise CatalogError(
+                    f"currval of sequence {name!r} is not yet defined")
+            return seq["last"]
 
     def remove_node(self, name: str) -> None:
         with self._lock:
@@ -277,6 +334,10 @@ class Catalog:
         with self._lock:
             if meta.name in self.tables:
                 raise CatalogError(f"table {meta.name!r} already distributed")
+            if meta.name in self.sequences:
+                # tables and sequences share one relation namespace
+                raise CatalogError(
+                    f"relation {meta.name!r} already exists (sequence)")
             self.tables[meta.name] = meta
             for s in shards:
                 self.shards[s.shard_id] = s
@@ -451,6 +512,7 @@ class Catalog:
             "nodes": {str(k): v.to_json() for k, v in self.nodes.items()},
             "colocation_groups": {str(k): v.to_json()
                                   for k, v in self.colocation_groups.items()},
+            "sequences": dict(self.sequences),
         }
 
     @staticmethod
@@ -471,6 +533,7 @@ class Catalog:
                      for k, v in obj.get("nodes", {}).items()}
         cat.colocation_groups = {int(k): ColocationGroup.from_json(v)
                                  for k, v in obj.get("colocation_groups", {}).items()}
+        cat.sequences = dict(obj.get("sequences", {}))
         return cat
 
     def save(self, path: str) -> None:

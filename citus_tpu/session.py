@@ -55,6 +55,7 @@ _UDFS = ("create_distributed_table", "create_reference_table",
          "citus_job_wait", "citus_job_cancel", "citus_job_list",
          "citus_change_feed", "citus_create_restore_point",
          "citus_check_cluster_node_health", "citus_promote_node",
+         "nextval", "currval",
          "citus_tables", "citus_shards")
 
 
@@ -273,6 +274,15 @@ class Session:
             return self._execute_setop(stmt)
         if isinstance(stmt, ast.CreateTable):
             return self._execute_create_table(stmt)
+        if isinstance(stmt, ast.CreateSequence):
+            self.catalog.create_sequence(stmt.name, stmt.start,
+                                         stmt.increment)
+            self._save_catalog()
+            return None
+        if isinstance(stmt, ast.DropSequence):
+            self.catalog.drop_sequence(stmt.name, stmt.if_exists)
+            self._save_catalog()
+            return None
         if isinstance(stmt, ast.AlterTable):
             return self._execute_alter_table(stmt)
         if isinstance(stmt, ast.DropTable):
@@ -430,6 +440,13 @@ class Session:
             n = promote_node_replicas(self, str(args[0]))
             return ResultSet(["placements_demoted"],
                              {"placements_demoted": [n]}, 1)
+        elif e.name == "nextval":
+            v, _inc = self.catalog.sequence_nextval(str(args[0]))
+            self._save_catalog()
+            return ResultSet(["nextval"], {"nextval": [v]}, 1)
+        elif e.name == "currval":
+            v = self.catalog.sequence_currval(str(args[0]))
+            return ResultSet(["currval"], {"currval": [v]}, 1)
         elif e.name == "citus_get_node_clock":
             from .transaction.clock import global_clock
 
@@ -747,12 +764,38 @@ class Session:
 
         meta = self.catalog.table(stmt.table)
         columns = stmt.columns or tuple(meta.schema.names)
+
+        def is_nextval(e):
+            return (isinstance(e, ast.FuncCall) and e.name == "nextval"
+                    and len(e.args) == 1
+                    and isinstance(e.args[0], ast.Literal))
+
+        # sequence values: allocate each sequence's whole range in ONE
+        # catalog bump (the per-node range allocation the reference does
+        # via worker sequence propagation, commands/sequence.c)
+        seq_counts: dict[str, int] = {}
+        for row in stmt.rows:
+            for e in row:
+                if is_nextval(e):
+                    name = str(e.args[0].value)
+                    seq_counts[name] = seq_counts.get(name, 0) + 1
+        seq_iters: dict[str, object] = {}
+        if seq_counts:
+            for name, cnt in seq_counts.items():
+                first, step = self.catalog.sequence_nextval(name, cnt)
+                seq_iters[name] = iter(
+                    range(first, first + step * cnt, step))
+            self._save_catalog()
+
         rows = []
         for row in stmt.rows:
             if len(row) != len(columns):
                 raise PlanningError("INSERT row arity mismatch")
             values = []
             for e in row:
+                if is_nextval(e):
+                    values.append(next(seq_iters[str(e.args[0].value)]))
+                    continue
                 if not isinstance(e, ast.Literal):
                     raise PlanningError("INSERT values must be literals")
                 if e.type_hint == "date":

@@ -445,6 +445,19 @@ class CreateTable(Statement):
 
 
 @dataclass(frozen=True)
+class CreateSequence(Statement):
+    name: str
+    start: int = 1
+    increment: int = 1
+
+
+@dataclass(frozen=True)
+class DropSequence(Statement):
+    name: str
+    if_exists: bool = False
+
+
+@dataclass(frozen=True)
 class DropTable(Statement):
     name: str
     if_exists: bool = False

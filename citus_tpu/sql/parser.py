@@ -705,8 +705,21 @@ class Parser:
                                   new_name=self.expect_ident())
         self.error("expected ADD, DROP, or RENAME after ALTER TABLE")
 
-    def parse_create_table(self) -> ast.CreateTable:
+    def parse_create_table(self) -> ast.Statement:
         self.expect_keyword("create")
+        if self.accept_word("sequence"):
+            name = self.expect_ident()
+            start, increment = 1, 1
+            while self.cur.kind in ("ident", "keyword"):
+                if self.accept_word("start"):
+                    self.accept_keyword("with")
+                    start = self._expect_signed_integer()
+                elif self.accept_word("increment"):
+                    self.accept_keyword("by")
+                    increment = self._expect_signed_integer()
+                else:
+                    self.error("expected START or INCREMENT")
+            return ast.CreateSequence(name, start, increment)
         self.expect_keyword("table")
         if_not_exists = False
         if self.accept_keyword("if"):
@@ -738,14 +751,24 @@ class Parser:
                 break
         return ast.ColumnSpec(name, type_name, not_null)
 
-    def parse_drop_table(self) -> ast.DropTable:
+    def parse_drop_table(self) -> ast.Statement:
         self.expect_keyword("drop")
-        self.expect_keyword("table")
+        is_seq = self.accept_word("sequence")
+        if not is_seq:
+            self.expect_keyword("table")
         if_exists = False
         if self.accept_keyword("if"):
             self.expect_keyword("exists")
             if_exists = True
-        return ast.DropTable(self.expect_ident(), if_exists)
+        name = self.expect_ident()
+        if is_seq:
+            return ast.DropSequence(name, if_exists)
+        return ast.DropTable(name, if_exists)
+
+    def _expect_signed_integer(self) -> int:
+        neg = self.accept_op("-")
+        v = self._expect_integer()
+        return -v if neg else v
 
     def parse_insert(self) -> ast.Statement:
         self.expect_keyword("insert")
