@@ -885,7 +885,7 @@ class PlanCompiler:
         probe with >1 match means the planner's uniqueness claim was
         stale: the surplus is reported as dense_oob so the host retries
         on the general expansion path (never silently dropped pairs)."""
-        from ..ops.join import _bounds
+        from ..ops.join import _bounds, dense_unique_lookup
 
         if node.join_type == "inner" and \
                 getattr(node, "build_side", "right") == "left":
@@ -897,13 +897,21 @@ class PlanCompiler:
             pblk, pkeys, pmatch = lblk, lkeys, lmatch
             extents = getattr(node, "right_key_extents", ())
         dense = self._dense_for(extents, bkeys)
-        order, lo, hi, dense_oob = _bounds(bkeys, bmatch, pkeys, dense)
-        counts = jnp.where(pmatch, hi - lo, 0)
+        if dense is not None and len(bkeys) == 1:
+            # unique build key (the fused-lookup planner claim): scatter
+            # directory, NO build-side argsort per execution
+            bidx, counts, dense_oob = dense_unique_lookup(
+                bkeys[0], bmatch, pkeys[0], dense[0], dense[1])
+            counts = jnp.where(pmatch, counts, 0)
+        else:
+            order, lo, hi, dense_oob = _bounds(bkeys, bmatch, pkeys,
+                                               dense)
+            counts = jnp.where(pmatch, hi - lo, 0)
+            m0 = bkeys[0].shape[0]
+            bidx = order[jnp.clip(lo, 0, m0 - 1)]
         self._dense_oob = self._dense_oob + dense_oob.astype(jnp.int64) + \
             jnp.maximum(counts - 1, 0).sum().astype(jnp.int64)
         found = counts > 0
-        m = bkeys[0].shape[0]
-        bidx = order[jnp.clip(lo, 0, m - 1)]
         probe_outer = node.join_type == "left"
         out_valid = pblk.valid if probe_outer else found
         # selective FK join: compact the probe side BEFORE gathering

@@ -164,6 +164,30 @@ def _upper_bound(sorted_keys, n_valid, probe_keys):
     return _search(sorted_keys, n_valid, probe_keys, _lex_leq)
 
 
+def _dense_slots(build_key: jnp.ndarray, build_matchable: jnp.ndarray,
+                 base: int, extent: int):
+    """Shared dense-directory build prologue: (slot [m] with out-of-range
+    rows parked at `extent`, per_slot counts [extent], oob_count).  Both
+    dense paths (counting-sort bounds and the sort-free unique lookup)
+    derive their stale-stats oob accounting from here so the retry
+    contract cannot diverge between them."""
+    idx = build_key.astype(jnp.int64) - jnp.int64(base)
+    inb = build_matchable & (idx >= 0) & (idx < extent)
+    oob = (build_matchable & ~inb).sum().astype(jnp.int64)
+    slot = jnp.where(inb, idx, extent).astype(jnp.int32)
+    per_slot = jax.ops.segment_sum(
+        inb.astype(jnp.int32), slot, num_segments=extent + 1)[:extent]
+    return slot, per_slot, oob
+
+
+def _probe_slots(probe_key: jnp.ndarray, base: int, extent: int):
+    """(pin [n], pc [n]): in-range mask + clipped slot per probe row."""
+    pidx = probe_key.astype(jnp.int64) - jnp.int64(base)
+    pin = (pidx >= 0) & (pidx < extent)
+    pc = jnp.clip(pidx, 0, extent - 1).astype(jnp.int32)
+    return pin, pc
+
+
 def _dense_bounds(build_key: jnp.ndarray, build_matchable: jnp.ndarray,
                   probe_key: jnp.ndarray, base: int, extent: int,
                   ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray,
@@ -176,22 +200,44 @@ def _dense_bounds(build_key: jnp.ndarray, build_matchable: jnp.ndarray,
     cannot be matched — their count comes back as `oob_count` so the
     caller can surface a retry-without-directory (stale-stats guard).
     """
-    idx = build_key.astype(jnp.int64) - jnp.int64(base)
-    inb = build_matchable & (idx >= 0) & (idx < extent)
-    oob = (build_matchable & ~inb).sum().astype(jnp.int64)
-    slot = jnp.where(inb, idx, extent).astype(jnp.int32)
-    counts = jax.ops.segment_sum(
-        inb.astype(jnp.int32), slot, num_segments=extent + 1)[:extent]
+    slot, counts, oob = _dense_slots(build_key, build_matchable, base,
+                                     extent)
     starts = jnp.concatenate([jnp.zeros(1, jnp.int32),
                               jnp.cumsum(counts, dtype=jnp.int32)])
     order = jnp.argsort(slot, stable=True).astype(jnp.int32)
 
-    pidx = probe_key.astype(jnp.int64) - jnp.int64(base)
-    pin = (pidx >= 0) & (pidx < extent)
-    pc = jnp.clip(pidx, 0, extent - 1).astype(jnp.int32)
+    pin, pc = _probe_slots(probe_key, base, extent)
     lo = jnp.where(pin, starts[pc], 0)
     hi = jnp.where(pin, starts[pc + 1], 0)
     return order, lo, hi, oob
+
+
+def dense_unique_lookup(build_key: jnp.ndarray,
+                        build_matchable: jnp.ndarray,
+                        probe_key: jnp.ndarray, base: int, extent: int,
+                        ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Sort-free dense lookup for a UNIQUE-keyed build side (the fused
+    PK-join path): one unique-index scatter builds `directory[slot] →
+    build row`, one gather probes it — no argsort over the build
+    capacity (the counting-sort directory in _dense_bounds pays an
+    O(m log m) argsort per execution, which dominated multi-join
+    queries at SF1 on real TPUs).
+
+    Returns (bidx [N], counts [N], oob_count).  counts carries per-probe
+    match counts INCLUDING duplicates, so the caller's existing
+    stale-uniqueness protocol (counts > 1 → dense_oob → retry on the
+    general expansion path) is unchanged; duplicate build rows also add
+    to oob so the retry always fires even if no probe hits them."""
+    m = build_key.shape[0]
+    slot, per_slot, oob = _dense_slots(build_key, build_matchable, base,
+                                       extent)
+    dup = jnp.maximum(per_slot - 1, 0).sum().astype(jnp.int64)
+    directory = jnp.full(extent, m, jnp.int32).at[slot].set(
+        jnp.arange(m, dtype=jnp.int32), mode="drop")
+    pin, pc = _probe_slots(probe_key, base, extent)
+    bidx = jnp.minimum(directory[pc], m - 1)
+    counts = jnp.where(pin, per_slot[pc], 0)
+    return bidx, counts, oob + dup
 
 
 def _bounds(build_keys, build_matchable, probe_keys,

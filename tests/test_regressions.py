@@ -399,3 +399,42 @@ def test_prepare_duplicate_name_rejected(sess):
         s.execute("prepare dup1 as select k from pp")
     s.execute("deallocate dup1")
     s.execute("prepare dup1 as select k from pp")  # freed name reusable
+
+
+def test_stale_unique_claim_with_duplicate_build_keys(sess):
+    """The sort-free dense directory (dense_unique_lookup) banks on the
+    planner's build-side uniqueness claim; duplicate build rows must
+    surface dense_oob and retry on the general expansion path — never a
+    silently-arbitrary single match."""
+    from citus_tpu.executor.feed import walk_plan
+    from citus_tpu.planner.plan import JoinNode
+    from citus_tpu.sql.parser import parse_one
+
+    sess.execute("create table ua (k bigint, v int)")
+    sess.create_distributed_table("ua", "k", shard_count=4)
+    sess.execute("create table ub (k bigint, w int)")
+    sess.create_distributed_table("ub", "k", shard_count=4)
+    sess.execute("insert into ua values (1,10),(2,20),(3,30)")
+    # build side has DUPLICATE k=2 — a correct result needs both matches
+    sess.execute("insert into ub values (1,1),(2,2),(2,5),(3,3)")
+    # a plain row-returning join (aggregates would take the pushdown
+    # path, which never fuses lookups)
+    plan, _cleanup = sess._plan_select(parse_one(
+        "select v, w from ua, ub where ua.k = ub.k"))
+    from citus_tpu.planner.plan import ScanNode
+
+    for node in walk_plan(plan.root):
+        if isinstance(node, JoinNode):
+            # force the DUPLICATED side (ub) as build with a stale
+            # "unique" claim
+            left_is_ub = isinstance(node.left, ScanNode) and \
+                node.left.rel.table == "ub"
+            node.fuse_lookup = True
+            node.build_side = "left" if left_is_ub else "right"
+            node.left_key_extents = ((1, 3),)
+            node.right_key_extents = ((1, 3),)
+    result = sess.executor.execute_plan(plan)
+    assert result.retries >= 1
+    rows = sorted(result.rows())
+    # pairs: (10,1) (20,2) (20,5) (30,3) — BOTH k=2 matches present
+    assert rows == [(10, 1), (20, 2), (20, 5), (30, 3)]
