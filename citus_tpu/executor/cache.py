@@ -81,7 +81,7 @@ def node_fingerprint(node: PlanNode) -> str:
         return (f"A({node.combine};{node.repart_keys};"
                 f"{node_fingerprint(node.input)};"
                 f"{groups};{aggs};{node.dense_keys};{node.dense_total};"
-                f"{_dist_sig(node.dist)})")
+                f"{node.key_ranges};{_dist_sig(node.dist)})")
     raise TypeError(f"unknown plan node {type(node).__name__}")
 
 

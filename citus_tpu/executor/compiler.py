@@ -1150,6 +1150,62 @@ class PlanCompiler:
         values = self._agg_values(node, blk)
         return key_arrays, key_meta, values
 
+    def _segment_aggregate_maybe_packed(self, node: AggregateNode,
+                                        key_arrays, key_meta, values,
+                                        valid):
+        """One dispatch point for both sort-path aggregation stages:
+        pack the composite key when ranges are known (accumulating the
+        stale-range oob), plain multi-key segment_aggregate otherwise."""
+        packed, pack_oob = self._pack_group_keys(node, key_arrays,
+                                                 key_meta, valid)
+        if packed is not None:
+            self._dense_oob = self._dense_oob + pack_oob
+            return segment_aggregate([packed], values, valid,
+                                     out_keys=key_arrays)
+        return segment_aggregate(key_arrays, values, valid)
+
+    def _pack_group_keys(self, node: AggregateNode, key_arrays, key_meta,
+                         valid):
+        """Composite group keys → ONE int64 sort key, using the
+        planner's statically-known ranges (key_ranges).  Returns
+        (packed [n] | None, oob scalar): single-operand argsorts are far
+        faster on TPU than the multi-operand lexsort; rows whose key
+        falls outside the planned range are COUNTED (they would alias
+        another slot) so the dense_oob retry recompiles with packing
+        off.  The null slot is always reserved — runtime null masks may
+        exist even when the planner believed a key non-nullable."""
+        kr = getattr(node, "key_ranges", None)
+        if kr is None or self.caps.dense_off or len(kr) != len(key_meta):
+            return None, None
+        expected = len(key_meta) + sum(1 for _c, f in key_meta if f)
+        if expected != len(key_arrays):
+            return None, None
+        n = valid.shape[0]
+        packed = jnp.zeros(n, jnp.int64)
+        oob = jnp.zeros((), jnp.int64)
+        ai = 0
+        for (base, extent, _hn), (cid, has_flag) in zip(kr, key_meta):
+            v = key_arrays[ai].astype(jnp.int64)
+            ai += 1
+            nm = None
+            if has_flag:
+                nm = key_arrays[ai] != 0
+                ai += 1
+            raw = v - jnp.int64(base)
+            inb = (raw >= 0) & (raw < extent)
+            width = extent + 1           # slot 0 = NULL
+            if nm is not None:
+                slot = jnp.where(nm, 0, raw + 1)
+                oob = oob + (valid & ~nm & ~inb).sum().astype(jnp.int64)
+            else:
+                slot = raw + 1
+                oob = oob + (valid & ~inb).sum().astype(jnp.int64)
+            packed = packed * width + jnp.clip(slot, 0, width - 1)
+        # invalid rows sort last (PACK_SLOT_LIMIT headroom guarantees no
+        # collision with a real slot)
+        packed = jnp.where(valid, packed, jnp.iinfo(jnp.int64).max)
+        return packed, oob
+
     @staticmethod
     def agg_pushdown_shape(node: AggregateNode) -> bool:
         """Static mirror of _try_join_agg_pushdown's eligibility: True ⇒
@@ -1313,8 +1369,8 @@ class PlanCompiler:
             else:
                 companions.append(None)
         all_values = values + [c for c in companions if c is not None]
-        gk, res, gvalid, ngroups = segment_aggregate(key_arrays, all_values,
-                                                     blk.valid)
+        gk, res, gvalid, ngroups = self._segment_aggregate_maybe_packed(
+            node, key_arrays, key_meta, all_values, blk.valid)
         gk, res, gvalid = self._slice_groups(node, gk, res, gvalid, ngroups)
         main_res = res[:len(values)]
         comp_res = res[len(values):]
@@ -1378,8 +1434,8 @@ class PlanCompiler:
                 comp_cids.append(cid)
         for cid in comp_cids:
             values2.append((shuffled.columns[f"__cnt_{cid}"], "sum", None))
-        gk2, res2, gvalid2, ngroups2 = segment_aggregate(
-            key_arrays2, values2, shuffled.valid)
+        gk2, res2, gvalid2, ngroups2 = self._segment_aggregate_maybe_packed(
+            node, key_arrays2, key_meta, values2, shuffled.valid)
         gk2, res2, gvalid2 = self._slice_groups(node, gk2, res2, gvalid2,
                                                 ngroups2)
         final = self._partial_block(node, key_meta, gk2,

@@ -95,14 +95,21 @@ def _segmented_scan(x: jnp.ndarray, boundary: jnp.ndarray, op):
 def segment_aggregate(keys: list[jnp.ndarray],
                       values: list[tuple[jnp.ndarray, str, jnp.ndarray | None]],
                       valid: jnp.ndarray,
+                      out_keys: list[jnp.ndarray] | None = None,
                       ) -> tuple[list[jnp.ndarray], list[jnp.ndarray], jnp.ndarray, jnp.ndarray]:
     """Group rows by `keys` and reduce.
 
     Args:
-      keys:   key columns, each [N].
+      keys:   key columns, each [N].  With a packed composite key this
+              is ONE int64 array (single-operand argsort — far faster
+              on TPU than a multi-operand lexsort).
       values: (array [N], kind, value_valid [N] | None) per aggregate;
               value_valid masks per-column NULLs (count(col), sum skips null).
       valid:  row validity [N].
+      out_keys: when set, group-key VALUES are extracted from these
+              arrays (the original columns) while ordering/boundary
+              detection runs on `keys` (the packed form — injective
+              over in-range rows, so the groupings agree).
 
     Returns (group_keys, agg_results, group_valid, n_groups):
       group_keys:  each [N], key value of each group slot,
@@ -111,7 +118,14 @@ def segment_aggregate(keys: list[jnp.ndarray],
       n_groups:    scalar int32.
     """
     n = valid.shape[0]
-    order = _sort_order(keys, valid)
+    if out_keys is not None:
+        # packed mode: the single int64 key already encodes invalid rows
+        # as the int64-max sentinel, so this is a TRUE single-operand
+        # argsort (adding the validity operand back would re-create the
+        # two-operand lexsort the packing exists to avoid)
+        order = jnp.argsort(keys[0], stable=True).astype(jnp.int32)
+    else:
+        order = _sort_order(keys, valid)
     keys_s = [k[order] for k in keys]
     valid_s = valid[order]
 
@@ -142,8 +156,11 @@ def segment_aggregate(keys: list[jnp.ndarray],
 
     group_keys = []
     first_c = jnp.minimum(starts, n - 1)
-    for k in keys_s:
-        group_keys.append(k[first_c])
+    if out_keys is None:
+        group_keys = [k[first_c] for k in keys_s]
+    else:
+        first_idx = order[first_c]
+        group_keys = [k[first_idx] for k in out_keys]
 
     results = []
     for arr, kind, value_valid in values:

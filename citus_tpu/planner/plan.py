@@ -150,6 +150,12 @@ class AggregateNode(PlanNode):
     # high-cardinality keys).  Entries: (base, extent, has_null) per key.
     dense_keys: Optional[tuple[tuple[int, int, bool], ...]] = None
     dense_total: int = 0
+    # (base, extent, has_null) per group key whenever every range is
+    # known (no size cap): the sort-path executor packs the composite
+    # key into ONE int64 so group detection rides a single-operand
+    # argsort instead of a multi-operand lexsort (TPU sorts are much
+    # faster single-operand); stale ranges retry via dense_oob
+    key_ranges: Optional[tuple[tuple[int, int, bool], ...]] = None
     # combine='repartition' only: route the shuffle by THIS subset of
     # group-key indices (None = all keys).  The DISTINCT rewrite routes
     # the dedupe level by the outer GROUP BY keys alone so the
@@ -1291,14 +1297,23 @@ class DistributedPlanner:
 
     DENSE_GROUP_LIMIT = 8192
 
+    # packed composite sort keys must leave headroom for the invalid-row
+    # sentinel and stay clear of int64 edges
+    PACK_SLOT_LIMIT = 1 << 62
+
     def _plan_dense_grid(self, node: AggregateNode,
                          nullable_rels: frozenset = frozenset()) -> None:
         """Annotate the aggregate with dense-slot metadata when every
-        group key is a bare column over a known small value range."""
+        group key is a bare column over a known small value range; and
+        with `key_ranges` whenever every key's range is known AT ALL —
+        the sort-path executor packs those into ONE int64 sort key
+        (single-operand argsort) instead of a multi-operand lexsort,
+        with stale ranges caught by the dense_oob retry protocol."""
         if not node.group_keys:
             return
         specs = []
         total = 1
+        pack_total = 1
         for g, _cid in node.group_keys:
             if not isinstance(g, ir.BCol) or not g.table:
                 return
@@ -1312,10 +1327,14 @@ class DistributedPlanner:
                         or g.rel_index in nullable_rels)
             specs.append((int(base), int(extent), has_null))
             total *= extent + (1 if has_null else 0)
-            if total > self.DENSE_GROUP_LIMIT:
-                return
-        node.dense_keys = tuple(specs)
-        node.dense_total = total
+            # the packed key always reserves the null slot (runtime null
+            # masks may exist even when the planner thinks otherwise)
+            pack_total *= extent + 1
+        if pack_total <= self.PACK_SLOT_LIMIT:
+            node.key_ranges = tuple(specs)
+        if total <= self.DENSE_GROUP_LIMIT:
+            node.dense_keys = tuple(specs)
+            node.dense_total = total
 
     def _column_nullable(self, col: ir.BCol) -> bool:
         try:

@@ -438,3 +438,29 @@ def test_stale_unique_claim_with_duplicate_build_keys(sess):
     rows = sorted(result.rows())
     # pairs: (10,1) (20,2) (20,5) (30,3) — BOTH k=2 matches present
     assert rows == [(10, 1), (20, 2), (20, 5), (30, 3)]
+
+
+def test_stale_group_key_range_retries_on_packed_sort(sess):
+    """The packed composite sort key (AggregateNode.key_ranges) clips
+    out-of-range key values, which would silently merge groups — stale
+    ranges must surface dense_oob and retry with packing off."""
+    from citus_tpu.executor.feed import walk_plan
+    from citus_tpu.planner.plan import AggregateNode
+    from citus_tpu.sql.parser import parse_one
+
+    sess.execute("create table pg1 (k bigint, g bigint, h bigint, v int)")
+    sess.create_distributed_table("pg1", "k", shard_count=4)
+    sess.execute("insert into pg1 values (1,1,1,10),(2,2,1,20),"
+                 "(3,7,2,30),(4,8,2,40)")
+    plan, _cleanup = sess._plan_select(parse_one(
+        "select g, h, sum(v) from pg1 group by g, h"))
+    for node in walk_plan(plan.root):
+        if isinstance(node, AggregateNode):
+            # stale claim: g in [1, 3), h in [1, 2) — rows with g=7,8 and
+            # h=2 fall outside and would clip onto other slots
+            node.key_ranges = ((1, 2, False), (1, 1, False))
+            node.dense_keys = None
+    result = sess.executor.execute_plan(plan)
+    assert result.retries >= 1
+    rows = sorted(result.rows())
+    assert rows == [(1, 1, 10), (2, 1, 20), (7, 2, 30), (8, 2, 40)]
