@@ -442,8 +442,18 @@ def rewrite_multi_distinct(sel: ast.Select, column_nullable) -> ast.Select:
                                  from_items=sel.from_items,
                                  where=sel.where,
                                  semi_joins=sel.semi_joins)
-                repl[call] = ast.FuncCall(
+                wrapped = ast.FuncCall(
                     "max", (ast.ScalarSubquery(sub),))
+                if call.name == "count":
+                    # count over an EMPTY input is 0, but the max() wrap
+                    # over the outer query's zero rows is NULL — and the
+                    # wrap is NULL exactly when the shared WHERE matched
+                    # nothing, where count is provably 0
+                    repl[call] = ast.CaseWhen(
+                        ((ast.IsNull(wrapped), ast.Literal(0)),),
+                        wrapped)
+                else:
+                    repl[call] = wrapped
             continue
         for g in sel.group_by:
             if not isinstance(g, ast.ColumnRef):

@@ -1257,46 +1257,28 @@ class Session:
             result = self._execute_subselect(limited)
             found = result.row_count > 0
             return ast.Literal(found != e.negated)
-        # structural recursion
-        if isinstance(e, ast.BinaryOp):
-            return ast.BinaryOp(e.op,
-                                self._rewrite_expr(e.left, cleanup, cte_scope),
-                                self._rewrite_expr(e.right, cleanup,
-                                                   cte_scope))
-        if isinstance(e, ast.UnaryOp):
-            return ast.UnaryOp(e.op, self._rewrite_expr(e.operand, cleanup,
-                                                        cte_scope))
-        if isinstance(e, ast.Between):
-            return ast.Between(
-                self._rewrite_expr(e.operand, cleanup, cte_scope),
-                self._rewrite_expr(e.low, cleanup, cte_scope),
-                self._rewrite_expr(e.high, cleanup, cte_scope), e.negated)
-        if isinstance(e, ast.InList):
-            return ast.InList(
-                self._rewrite_expr(e.operand, cleanup, cte_scope),
-                tuple(self._rewrite_expr(x, cleanup, cte_scope)
-                      for x in e.items), e.negated)
-        if isinstance(e, ast.CaseWhen):
-            return ast.CaseWhen(
-                tuple((self._rewrite_expr(c, cleanup, cte_scope),
-                       self._rewrite_expr(r, cleanup, cte_scope))
-                      for c, r in e.whens),
-                (self._rewrite_expr(e.else_result, cleanup, cte_scope)
-                 if e.else_result is not None else None))
-        if isinstance(e, ast.FuncCall):
-            window = e.window
-            if window is not None:
-                window = ast.WindowSpec(
-                    tuple(self._rewrite_expr(p, cleanup, cte_scope)
-                          for p in window.partition_by),
-                    tuple((self._rewrite_expr(o, cleanup, cte_scope), d)
-                          for o, d in window.order_by))
+        # structural recursion: window specs carry expressions that the
+        # generic mapper doesn't descend into
+        if isinstance(e, ast.FuncCall) and e.window is not None:
+            window = ast.WindowSpec(
+                tuple(self._rewrite_expr(p, cleanup, cte_scope)
+                      for p in e.window.partition_by),
+                tuple((self._rewrite_expr(o, cleanup, cte_scope), d)
+                      for o, d in e.window.order_by))
             return ast.FuncCall(e.name,
                                 tuple(self._rewrite_expr(a, cleanup,
                                                          cte_scope)
                                       for a in e.args),
                                 e.distinct, e.star, window)
-        return e
+        # everything else (BinaryOp/UnaryOp/IsNull/Between/InList/Like/
+        # Cast/Extract/Substring/CaseWhen/FuncCall/leaves) maps through
+        # the shared structural rebuilder — hand-rolled per-node copies
+        # kept missing node kinds, leaving nested subqueries unplanned
+        # (IsNull/Cast/Extract/Substring all had the bug)
+        from .planner.decorrelate import _map_children
+
+        return _map_children(
+            e, lambda c: self._rewrite_expr(c, cleanup, cte_scope))
 
     def _materialize(self, sel: ast.Select, cleanup: list[str],
                      column_names: tuple[str, ...] = ()) -> str:

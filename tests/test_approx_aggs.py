@@ -169,3 +169,4 @@ class TestSemiJoinInteraction:
             "select approx_percentile(v, 0.5) from t").rows()[0][0]
         # NULL excluded; histogram quantile is the mass-point answer
         assert 0.9 <= r <= 3.1
+

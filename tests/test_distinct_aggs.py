@@ -108,3 +108,36 @@ def test_multiple_distinct_args_supported(sess):
     b = sess.execute(
         "select count(distinct l_partkey) from lineitem").rows()[0][0]
     assert r.rows() == [(a, b)]
+
+
+def test_multi_distinct_over_empty_input(tmp_path):
+    # fuzz seed 505 #57: count(distinct) must be 0 over zero rows even
+    # for the rewritten (non-first) distinct argument — the max() wrap
+    # alone turns it into NULL
+    s = citus_tpu.connect(data_dir=str(tmp_path / "d"), n_devices=4,
+                          compute_dtype="float64")
+    s.execute("create table me (a bigint, b bigint)")
+    s.create_distributed_table("me", "a", shard_count=4)
+    s.execute("insert into me values (1, 2), (3, 4)")
+    r = s.execute("select count(distinct a), count(distinct b), "
+                  "sum(distinct b) from me where a >= 900").rows()[0]
+    assert r == (0, 0, None)
+    s.close()
+
+
+def test_subqueries_in_every_expression_position(tmp_path):
+    # the expression rewriter previously hand-copied node kinds and
+    # skipped IsNull/Cast/Extract/Substring, leaving nested subqueries
+    # unplanned; it now maps through the shared structural rebuilder
+    s = citus_tpu.connect(data_dir=str(tmp_path / "d"), n_devices=4,
+                          compute_dtype="float64")
+    s.execute("create table sx (k bigint, v bigint)")
+    s.create_distributed_table("sx", "k", shard_count=4)
+    s.execute("insert into sx values (1, 10), (2, 20), (3, 30)")
+    r = s.execute("select cast((select max(v) from sx) as bigint) "
+                  "from sx where k = 1")
+    assert r.rows() == [(30,)]
+    r = s.execute("select k from sx where ((select max(v) from sx) "
+                  "is null) = false order by k")
+    assert [x for (x,) in r.rows()] == [1, 2, 3]
+    s.close()
