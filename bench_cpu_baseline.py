@@ -73,6 +73,13 @@ def main() -> None:
                           "sf": sf, "engine": "sqlite3-1core"}),
               flush=True)
 
+    if sf != 1.0:
+        # mirror bench.py's _publish guard: a smoke run at another scale
+        # must not clobber the published SF1 CPU denominators (bench.py
+        # would then silently drop its vs_cpu ratios on sf mismatch)
+        print(f"# sf={sf} != 1.0: not publishing to BASELINE.json",
+              file=sys.stderr)
+        return
     path = os.path.join(HERE, "BASELINE.json")
     try:
         with open(path) as f:

@@ -153,7 +153,12 @@ class Catalog:
         # name → {"next": int, "increment": int} (pg_dist_object-propagated
         # sequences analogue; single-controller, so no per-node ranges)
         self.sequences: dict[str, dict] = {}
+        # name → {"sql": str, "columns": [str]} — view definitions
+        # (reference propagates views to workers, commands/view.c:1-832;
+        # one controller keeps one persisted definition)
+        self.views: dict[str, dict] = {}
         self.version = 0
+        self._disk_stat = None  # (mtime_ns, size) of the persisted file
         self._next_shard_id = 102008   # reference shard ids start ~102008
         self._next_placement_id = 1
         self._next_node_id = 1
@@ -207,7 +212,8 @@ class Catalog:
         to workers and hands out per-node ranges,
         commands/sequence.c:1-40; one controller needs one counter)."""
         with self._lock:
-            if name in self.sequences or name in self.tables:
+            if name in self.sequences or name in self.tables or \
+                    name in self.views:
                 raise CatalogError(f"relation {name!r} already exists")
             if increment == 0:
                 raise CatalogError("sequence increment must be nonzero")
@@ -223,6 +229,26 @@ class Catalog:
                     return
                 raise CatalogError(f"sequence {name!r} does not exist")
             del self.sequences[name]
+            self._bump()
+
+    # -- views -------------------------------------------------------------
+    def create_view(self, name: str, sql: str,
+                    columns: tuple[str, ...] = (),
+                    or_replace: bool = False) -> None:
+        with self._lock:
+            if name in self.tables or name in self.sequences or \
+                    (name in self.views and not or_replace):
+                raise CatalogError(f"relation {name!r} already exists")
+            self.views[name] = {"sql": sql, "columns": list(columns)}
+            self._bump()
+
+    def drop_view(self, name: str, if_exists: bool = False) -> None:
+        with self._lock:
+            if name not in self.views:
+                if if_exists:
+                    return
+                raise CatalogError(f"view {name!r} does not exist")
+            del self.views[name]
             self._bump()
 
     def sequence_nextval(self, name: str,
@@ -334,10 +360,10 @@ class Catalog:
         with self._lock:
             if meta.name in self.tables:
                 raise CatalogError(f"table {meta.name!r} already distributed")
-            if meta.name in self.sequences:
-                # tables and sequences share one relation namespace
+            if meta.name in self.sequences or meta.name in self.views:
+                # tables, sequences and views share one relation namespace
                 raise CatalogError(
-                    f"relation {meta.name!r} already exists (sequence)")
+                    f"relation {meta.name!r} already exists")
             self.tables[meta.name] = meta
             for s in shards:
                 self.shards[s.shard_id] = s
@@ -513,6 +539,7 @@ class Catalog:
             "colocation_groups": {str(k): v.to_json()
                                   for k, v in self.colocation_groups.items()},
             "sequences": dict(self.sequences),
+            "views": dict(self.views),
         }
 
     @staticmethod
@@ -534,15 +561,63 @@ class Catalog:
         cat.colocation_groups = {int(k): ColocationGroup.from_json(v)
                                  for k, v in obj.get("colocation_groups", {}).items()}
         cat.sequences = dict(obj.get("sequences", {}))
+        cat.views = dict(obj.get("views", {}))
         return cat
 
     def save(self, path: str) -> None:
         """Atomic durable write — the catalog's durability primitive."""
+        import os
+
         from ..utils.io import atomic_write_json
 
         atomic_write_json(path, self.to_json())
+        try:
+            st = os.stat(path)
+            self._disk_stat = (st.st_mtime_ns, st.st_size)
+        except OSError:
+            self._disk_stat = None
 
     @staticmethod
     def load(path: str) -> "Catalog":
+        import os
+
         with open(path) as f:
-            return Catalog.from_json(json.load(f))
+            cat = Catalog.from_json(json.load(f))
+        try:
+            st = os.stat(path)
+            cat._disk_stat = (st.st_mtime_ns, st.st_size)
+        except OSError:
+            cat._disk_stat = None
+        return cat
+
+    def maybe_reload(self, path: str) -> bool:
+        """Adopt another session's committed catalog when the on-disk
+        file changed (one stat() per check) — the single-file analogue
+        of the reference's metadata-cache invalidation callbacks
+        (metadata/metadata_cache.c:287).  In-place: executors/stores
+        hold references to THIS object.  Returns True on reload."""
+        import os
+
+        try:
+            st = os.stat(path)
+            disk = (st.st_mtime_ns, st.st_size)
+        except OSError:
+            return False
+        with self._lock:
+            if getattr(self, "_disk_stat", None) == disk:
+                return False
+            fresh = Catalog.load(path)
+            self.tables = fresh.tables
+            self.shards = fresh.shards
+            self.placements = fresh.placements
+            self.nodes = fresh.nodes
+            self.colocation_groups = fresh.colocation_groups
+            self.sequences = fresh.sequences
+            self.views = fresh.views
+            self._next_shard_id = fresh._next_shard_id
+            self._next_placement_id = fresh._next_placement_id
+            self._next_node_id = fresh._next_node_id
+            self._next_colocation_id = fresh._next_colocation_id
+            self._disk_stat = fresh._disk_stat
+            self._bump()
+            return True

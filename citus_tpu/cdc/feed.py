@@ -47,6 +47,36 @@ class ChangeLog:
         self._tail_checked = False
         self.torn_lines = 0
         self._next_lsn = self._scan_next_lsn()
+        # journal size as of OUR last append: a mismatch under the file
+        # lock means another session appended — re-sync the lsn cursor
+        # from the tail so the feed stays ONE total order (the WAL-LSN
+        # property logical decoding gives the reference for free)
+        self._expected_size = self._file_size()
+
+    def _file_size(self) -> int:
+        try:
+            return os.path.getsize(self.path)
+        except OSError:
+            return 0
+
+    def _tail_max_lsn(self) -> int:
+        """Max lsn among the last block's parseable lines (events are
+        appended in lsn order, so the journal tail carries the max)."""
+        try:
+            with open(self.path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                f.seek(max(0, size - (256 << 10)))
+                block = f.read()
+        except OSError:
+            return 0
+        top = 0
+        for line in block.splitlines():
+            try:
+                top = max(top, int(json.loads(line)["lsn"]))
+            except (ValueError, KeyError):
+                continue  # partial first line of the block / torn tail
+        return top
 
     def _scan_next_lsn(self) -> int:
         """Max parseable lsn + 1.  A crash mid-append can tear the LAST
@@ -107,35 +137,52 @@ class ChangeLog:
             return
         from ..utils.faultinjection import fault_point
 
+        import fcntl
+
         with self._mu:
             # named seam: a crash before the journal append must lose at
             # most the in-flight commit's events (at-most-once window),
             # never corrupt earlier lines
             fault_point("cdc.append")
-            now = time.time()
-            payload = []
-            for ev in events:
-                ev["lsn"] = self._next_lsn
-                ev["ts"] = now
-                self._next_lsn += 1
-                payload.append(json.dumps(ev))
-            lead = ""
-            if not self._tail_checked:
-                # a crash may have torn the last line mid-append; isolate
-                # the partial tail so this commit's first event stays
-                # parseable instead of concatenating onto the garbage
-                self._tail_checked = True
-                try:
-                    with open(self.path, "rb") as rf:
-                        rf.seek(-1, os.SEEK_END)
-                        if rf.read(1) != b"\n":
-                            lead = "\n"
-                except OSError:
-                    pass  # no file / empty file: nothing to isolate
             with open(self.path, "a") as f:
-                f.write(lead + "\n".join(payload) + "\n")
-                f.flush()
-                os.fsync(f.fileno())
+                # exclusive journal lock: concurrent sessions (threads or
+                # processes) serialize their appends and allocate from
+                # ONE lsn sequence
+                fcntl.flock(f.fileno(), fcntl.LOCK_EX)
+                try:
+                    if self._file_size() != self._expected_size:
+                        # another session appended since our last write:
+                        # adopt its lsns before allocating ours
+                        self._next_lsn = max(self._next_lsn,
+                                             self._tail_max_lsn() + 1)
+                        self._tail_checked = False
+                    now = time.time()
+                    payload = []
+                    for ev in events:
+                        ev["lsn"] = self._next_lsn
+                        ev["ts"] = now
+                        self._next_lsn += 1
+                        payload.append(json.dumps(ev))
+                    lead = ""
+                    if not self._tail_checked:
+                        # a crash may have torn the last line mid-append;
+                        # isolate the partial tail so this commit's first
+                        # event stays parseable instead of concatenating
+                        # onto the garbage
+                        self._tail_checked = True
+                        try:
+                            with open(self.path, "rb") as rf:
+                                rf.seek(-1, os.SEEK_END)
+                                if rf.read(1) != b"\n":
+                                    lead = "\n"
+                        except OSError:
+                            pass  # empty file: nothing to isolate
+                    f.write(lead + "\n".join(payload) + "\n")
+                    f.flush()
+                    os.fsync(f.fileno())
+                    self._expected_size = f.tell()
+                finally:
+                    fcntl.flock(f.fileno(), fcntl.LOCK_UN)
 
     # -- consumer --------------------------------------------------------
     def read(self, table: str | None = None, from_lsn: int = 0,

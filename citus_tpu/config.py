@@ -83,10 +83,23 @@ _register(ConfigVar(
     "Static aggregate-output headroom over the estimated group count.",
     float, min_value=1.0, max_value=64.0))
 _register(ConfigVar(
+    "enable_capacity_feedback", True,
+    "After a clean execution, shrink buffers whose recorded actual row "
+    "counts sit far below the planner's estimate and recompile once "
+    "(the adaptive-executor actual-size feedback, adaptive_executor.c:962"
+    ", done the static-shape way).",
+    bool))
+_register(ConfigVar(
     "enable_fast_path_router", True,
     "Execute single-shard pruned queries host-side, skipping the mesh "
     "program entirely (ref: citus.enable_fast_path_router_planner, "
     "planner/fast_path_router_planner.c:530).",
+    bool))
+_register(ConfigVar(
+    "enable_point_lookup_index", True,
+    "Answer WHERE distcol = const through the persistent per-shard "
+    "point-lookup index (storage/pkindex.py; ref: columnar btree/hash "
+    "index support, columnar/README.md:176).",
     bool))
 _register(ConfigVar(
     "fast_path_max_rows", 65536,

@@ -208,6 +208,9 @@ class Capacities:
     # pack into this many slots so downstream joins/aggregates size by
     # the filtered estimate, not the full table
     scan_out: dict[int, int] = None
+    # per-(source, target) bucket slots for the INSERT..SELECT output
+    # shuffle (QueryPlan.output_repart); None when the plan has none
+    output_repart: int | None = None
 
     def __post_init__(self):
         if self.agg_out is None:
@@ -227,7 +230,9 @@ class Capacities:
                           {k: g(v) for k, v in self.join_out.items()},
                           {k: g(v) for k, v in self.agg_out.items()},
                           self.dense_off,
-                          {k: g(v) for k, v in self.scan_out.items()})
+                          {k: g(v) for k, v in self.scan_out.items()},
+                          g(self.output_repart)
+                          if self.output_repart else None)
 
 
 class PlanCompiler:
@@ -257,6 +262,21 @@ class PlanCompiler:
         device→host transfers total instead of one per column — on
         remote-attached TPUs each transfer pays a full round trip.
         out_meta describes how to unpack (see unpack_outputs)."""
+        from .cache import plan_order
+
+        # adaptive-capacity feedback (the static-shape answer to the
+        # reference's adaptive executor streaming ACTUAL result sizes,
+        # adaptive_executor.c:962): every capacity-consuming stage
+        # records its true row count into the overflow transfer, and the
+        # host tightens over-estimated buffers + recompiles once, so
+        # warm executions run at near-actual sizes even when the
+        # planner's estimate was 10× off (e.g. Q3's correlated
+        # date-range join selectivity, statically unestimable)
+        self._walk_order = plan_order(self.plan)
+        self._stage_actual = {}
+        self._stage_width = {}
+        self.stage_keys = []
+
         feed_arrays = []
         in_specs = []
         feed_index = {}
@@ -307,7 +327,19 @@ class PlanCompiler:
                 blocks = self._unpack_feeds(flat_feeds)
                 self._overflow = jnp.zeros((), dtype=jnp.int64)
                 self._dense_oob = jnp.zeros((), dtype=jnp.int64)
+                self._stage_actual = {}
                 out = self._exec(self.plan.root, blocks)
+                if self.plan.output_repart is not None:
+                    # INSERT..SELECT device routing: shuffle the final
+                    # block to the TARGET table's sharding so the host
+                    # writes per-device slices without re-hashing
+                    shard_count, placement, bounds, key_expr = \
+                        self.plan.output_repart
+                    out = self._repartition(
+                        out, [key_expr], shard_count, placement,
+                        self.caps.output_repart,
+                        keep_null_rows=True,  # host raises on NULL dist
+                        bounds=bounds or None)
                 if self.plan.root.dist.kind == "replicated":
                     # every device holds identical rows; emit from
                     # device 0 only
@@ -328,11 +360,19 @@ class PlanCompiler:
             nulls = {cid: jnp.broadcast_to(out.null_mask(cid),
                                            out.valid.shape)[None, :]
                      for cid in out_cids}
-            # overflow block per device: [capacity_overflow, dense_oob] —
-            # the host grows buffers for the first, drops stale dense
-            # structures for the second
+            # overflow block per device: [capacity_overflow, dense_oob,
+            # *stage_actuals] — the host grows buffers for the first,
+            # drops stale dense structures for the second, and tightens
+            # over-sized buffers from the rest (feedback)
+            skeys = sorted(self._stage_actual,
+                           key=lambda k: (self._walk_order.get(
+                               k[0], 1 << 30), k[1]))
+            self.stage_keys = [
+                (self._walk_order.get(nid, -1), kind,
+                 self._stage_width[(nid, kind)]) for nid, kind in skeys]
             return (cols, nulls, out.valid[None, :],
-                    jnp.stack([self._overflow, self._dense_oob]))
+                    jnp.stack([self._overflow, self._dense_oob]
+                              + [self._stage_actual[k] for k in skeys]))
 
         mapped = shard_map(body, mesh=self.mesh,
                            in_specs=tuple(in_specs), out_specs=out_specs,
@@ -360,7 +400,10 @@ class PlanCompiler:
         # the FeedSpec device arrays so the plan cache pins only code +
         # metadata, not every input table's HBM buffers
         self.feeds = None
-        return jax.jit(packed_fn), feed_arrays, out_meta
+        # stage_keys was populated by the eval_shape trace above; entries
+        # are (walk_index, kind, width) — walk indices, not node ids, so
+        # a plan-cache hit from a different plan instance can map them
+        return jax.jit(packed_fn), feed_arrays, out_meta, self.stage_keys
 
     # ------------------------------------------------------------------
     def _unpack_feeds(self, flat_feeds) -> dict[int, Block]:
@@ -425,7 +468,8 @@ class PlanCompiler:
                 karr = [jnp.zeros(blk.valid.shape, jnp.int64)]
             blk = self._repartition(blk, None, self.n_dev,
                                     tuple(range(self.n_dev)), cap,
-                                    key_arrays=karr, valid=blk.valid)
+                                    key_arrays=karr, valid=blk.valid,
+                                    record_nid=id(node))
         n = blk.valid.shape[0]
         src = _src(blk)
 
@@ -595,6 +639,22 @@ class PlanCompiler:
         iota = jnp.arange(n, dtype=jnp.int32)
         return scan[_seg_last(part_boundary, iota)]
 
+    def _record(self, nid: int, kind: str, count, width: int) -> None:
+        """Track one capacity-consuming stage's ACTUAL row count (traced
+        scalar) and its buffer width (static).  Multiple records for the
+        same (node, kind) — e.g. repart_both's two shuffles, or the two
+        sort-path aggregation levels — merge by max: the shared buffer
+        must cover the larger."""
+        key = (nid, kind)
+        c = count.astype(jnp.int64)
+        if key in self._stage_actual:
+            self._stage_actual[key] = jnp.maximum(self._stage_actual[key],
+                                                  c)
+        else:
+            self._stage_actual[key] = c
+        self._stage_width[key] = max(int(width),
+                                     self._stage_width.get(key, 0))
+
     def _exec(self, node: PlanNode, feeds: dict[int, Block]) -> Block:
         if isinstance(node, ScanNode):
             blk = feeds[id(node)]
@@ -602,6 +662,8 @@ class PlanCompiler:
                 mask = predicate_mask(node.filter,
                                       _src(blk), jnp)
                 blk = blk.with_filter(mask)
+                self._record(id(node), "scan_out", blk.valid.sum(),
+                             blk.valid.shape[0])
                 k = self.caps.scan_out.get(id(node))
                 if k is not None and k < blk.valid.shape[0]:
                     blk = self._compact(blk, k)
@@ -750,7 +812,8 @@ class PlanCompiler:
                      key_arrays: list | None = None,
                      valid: jnp.ndarray | None = None,
                      keep_null_rows: bool = False,
-                     bounds: tuple[int, ...] | None = None) -> Block:
+                     bounds: tuple[int, ...] | None = None,
+                     record_nid: int | None = None) -> Block:
         """pack → all_to_all → flatten: the map+fetch phases fused.
 
         When repartitioning toward a TABLE's sharding (repart_left/right),
@@ -787,6 +850,12 @@ class PlanCompiler:
                 shard_count - 1).astype(jnp.int32)
         placement_arr = jnp.asarray(np.asarray(placement, dtype=np.int32))
         target = placement_arr[shard]
+        if record_nid is not None:
+            # the binding constraint on this buffer is the largest
+            # (source device → target device) bucket
+            sent = jnp.zeros(self.n_dev, jnp.int32).at[target].add(
+                valid.astype(jnp.int32), mode="drop")
+            self._record(record_nid, "repartition", sent.max(), capacity)
 
         all_cols = dict(blk.columns)
         for cid, nmask in blk.nulls.items():
@@ -845,7 +914,8 @@ class PlanCompiler:
                                      node.left.dist.shard_count,
                                      node.left.dist.placement, cap,
                                      keep_null_rows=keep_r,
-                                     bounds=node.left.dist.bounds or None)
+                                     bounds=node.left.dist.bounds or None,
+                                     record_nid=id(node))
         elif node.strategy == "repart_left":
             cap = self.caps.repartition[id(node)]
             lblk = self._repartition(lblk,
@@ -853,14 +923,17 @@ class PlanCompiler:
                                      node.right.dist.shard_count,
                                      node.right.dist.placement, cap,
                                      keep_null_rows=keep_l,
-                                     bounds=node.right.dist.bounds or None)
+                                     bounds=node.right.dist.bounds or None,
+                                     record_nid=id(node))
         elif node.strategy == "repart_both":
             cap = self.caps.repartition[id(node)]
             identity = tuple(range(self.n_dev))
             lblk = self._repartition(lblk, node.left_keys, self.n_dev,
-                                     identity, cap, keep_null_rows=keep_l)
+                                     identity, cap, keep_null_rows=keep_l,
+                                     record_nid=id(node))
             rblk = self._repartition(rblk, node.right_keys, self.n_dev,
-                                     identity, cap, keep_null_rows=keep_r)
+                                     identity, cap, keep_null_rows=keep_r,
+                                     record_nid=id(node))
         else:
             raise ExecutionError(f"bad join strategy {node.strategy}")
 
@@ -914,6 +987,9 @@ class PlanCompiler:
         found = counts > 0
         probe_outer = node.join_type == "left"
         out_valid = pblk.valid if probe_outer else found
+        if not probe_outer and node.residual is None:
+            self._record(id(node), "join_out", out_valid.sum(),
+                         out_valid.shape[0])
         # selective FK join: compact the probe side BEFORE gathering
         # build columns, so the gathers and everything downstream run at
         # the join-estimate size instead of the probe capacity
@@ -953,6 +1029,14 @@ class PlanCompiler:
             if node.residual is not None:
                 blk = blk.with_filter(predicate_mask(node.residual,
                                                      _src(blk), jnp))
+                if node.join_type == "inner":
+                    # post-residual compaction: the residual-selective
+                    # fused join can still shrink to its feedback size
+                    self._record(id(node), "join_out", blk.valid.sum(),
+                                 blk.valid.shape[0])
+                    k = self.caps.join_out.get(id(node))
+                    if k is not None and k < blk.valid.shape[0]:
+                        blk = self._compact(blk, k)
             return blk
         out_cap = self.caps.join_out[id(node)]
 
@@ -973,6 +1057,7 @@ class PlanCompiler:
                                   out_cap, probe_outer=False, dense=dense)
             self._overflow = self._overflow + overflow.astype(jnp.int64)
             self._dense_oob = self._dense_oob + dense_oob.astype(jnp.int64)
+            self._record(id(node), "join_out", out_valid.sum(), out_cap)
             cols, nulls = {}, {}
             for cid, arr in pblk.columns.items():
                 cols[cid] = arr[pidx]
@@ -1023,6 +1108,7 @@ class PlanCompiler:
                                   cap, probe_outer=False, dense=dense)
             self._overflow = self._overflow + overflow.astype(jnp.int64)
             self._dense_oob = self._dense_oob + dense_oob.astype(jnp.int64)
+            self._record(id(node), "join_out", out_valid.sum(), cap)
             # gather ONLY the residual's columns at pair capacity — the
             # output block is the probe block, so everything else would
             # be wasted HBM traffic on the widest intermediate
@@ -1075,6 +1161,7 @@ class PlanCompiler:
                                 replicated_build, SHARD_AXIS, dense=dense)
         self._overflow = self._overflow + overflow.astype(jnp.int64)
         self._dense_oob = self._dense_oob + dense_oob.astype(jnp.int64)
+        self._record(id(node), "join_out", pair_valid.sum(), out_cap)
 
         cols, nulls = {}, {}
         for cid, arr in lblk.columns.items():
@@ -1416,7 +1503,8 @@ class PlanCompiler:
         shuffled = self._repartition(partial, None, self.n_dev,
                                      tuple(range(self.n_dev)), cap,
                                      key_arrays=shuffle_keys,
-                                     valid=partial.valid)
+                                     valid=partial.valid,
+                                     record_nid=id(node))
         key_arrays2 = []
         for cid, has_null in key_meta:
             key_arrays2.append(shuffled.columns[cid])
@@ -1611,6 +1699,7 @@ class PlanCompiler:
     def _slice_groups(self, node: AggregateNode, gk, res, gvalid, ngroups):
         """Slice front-packed group slots down to the planner's estimated
         capacity; groups beyond it count as overflow (→ retry, doubled)."""
+        self._record(id(node), "agg_out", ngroups, gvalid.shape[0])
         agg_cap = self.caps.agg_out.get(id(node))
         if agg_cap is None or agg_cap >= gvalid.shape[0]:
             return gk, res, gvalid

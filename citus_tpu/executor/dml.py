@@ -107,8 +107,10 @@ def execute_delete(session, stmt: ast.Delete):
     cols = _pred_columns(bound, rel)
     deletes: dict[int, dict[str, np.ndarray]] = {}
     count = 0
-    shards = _target_shards(session, stmt.table, rel, bound.conjuncts)
-    with session._dml_locks(stmt.table, [s.shard_id for s in shards]):
+    with session._dml_locks(
+            stmt.table,
+            lambda: _target_shards(session, stmt.table, rel,
+                                   bound.conjuncts)) as shards:
         for shard in shards:
             for rec in session.store.shard_stripe_records(stmt.table,
                                                           shard.shard_id):
@@ -182,8 +184,10 @@ def execute_update(session, stmt: ast.Update):
     codec = session.settings.get("columnar_compression")
     level = session.settings.get("columnar_compression_level")
     chunk_rows = session.settings.get("columnar_chunk_group_row_limit")
-    shards = _target_shards(session, stmt.table, rel, bound.conjuncts)
-    with session._dml_locks(stmt.table, [s.shard_id for s in shards]):
+    with session._dml_locks(
+            stmt.table,
+            lambda: _target_shards(session, stmt.table, rel,
+                                   bound.conjuncts)) as shards:
         try:
             count = _update_shards(session, stmt, meta, bound, rel,
                                    bound_assign, direct, deletes, pending,
@@ -386,7 +390,6 @@ def execute_merge(session, stmt: ast.Merge):
         raise UnsupportedQueryError(
             "MERGE ON must contain at least one target = source equality")
 
-    shards = session.catalog.table_shards(stmt.target)
     if meta.method == DistributionMethod.HASH:
         dist_pairs = [p for p in pairs if p[0] == meta.distribution_column]
         if not dist_pairs:
@@ -405,16 +408,22 @@ def execute_merge(session, stmt: ast.Merge):
         else:
             tokens = hash_token(np.asarray(
                 [0 if x is None else x for x in dv], dtype=dt.numpy_dtype))
-        src_shard = np.asarray(
-            shard_index_for_token_ranges(
-                tokens, session.catalog.shard_mins(stmt.target)),
-            dtype=np.int64)
-        if dn is not None:
-            # NULL join keys never match; those source rows go straight to
-            # WHEN NOT MATCHED handling (PostgreSQL semantics)
-            src_shard = np.where(dn, np.int64(-1), src_shard)
+
+        def _route():
+            # shard INDEXES come from the catalog — derived under the
+            # DML locks so a concurrent split can't strand source rows
+            src_shard = np.asarray(
+                shard_index_for_token_ranges(
+                    tokens, session.catalog.shard_mins(stmt.target)),
+                dtype=np.int64)
+            if dn is not None:
+                # NULL join keys never match; those source rows go
+                # straight to WHEN NOT MATCHED (PostgreSQL semantics)
+                src_shard = np.where(dn, np.int64(-1), src_shard)
+            return src_shard
     else:
-        src_shard = np.zeros(src_n, dtype=np.int64)
+        def _route():
+            return np.zeros(src_n, dtype=np.int64)
 
     codec = session.settings.get("columnar_compression")
     level = session.settings.get("columnar_compression_level")
@@ -422,7 +431,10 @@ def execute_merge(session, stmt: ast.Merge):
     all_deletes: dict[int, dict[str, np.ndarray]] = {}
     all_pending: list[tuple[int, dict]] = []
 
-    with session._dml_locks(stmt.target, [s.shard_id for s in shards]):
+    with session._dml_locks(
+            stmt.target,
+            lambda: session.catalog.table_shards(stmt.target)) as shards:
+        src_shard = _route()
         try:
             n_updated, n_deleted, n_inserted, insert_cols, insert_rows_acc = \
                 _merge_shards(session, stmt, meta, shards, src_shard,

@@ -195,6 +195,11 @@ def evaluate(e: ir.BExpr, src: ColumnSource, xp):
         if e.op == "ln":
             return xp.log(v), nmask
         raise ExecutionError(f"bad math op {e.op}")
+    if isinstance(e, ir.BDDBucket):
+        from ..ops.sketches import dd_bucket
+
+        v, nmask = evaluate(e.operand, src, xp)
+        return dd_bucket(v.astype(_dt(DataType.FLOAT64, xp)), xp), nmask
     if isinstance(e, (ir.BHllBucket, ir.BHllRho)):
         v, nmask = evaluate(e.operand, src, xp)
         h = _hash32(v, xp)

@@ -23,9 +23,53 @@ from __future__ import annotations
 import numpy as np
 
 from ..catalog import DistributionMethod
+from ..planner import expr as ir
 from ..planner.plan import JoinNode, ProjectNode, QueryPlan, ScanNode
+from ..types import DataType
 from .exprs import ColumnSource, evaluate, predicate_mask
 from .feed import make_chunk_filter, walk_plan
+
+
+def _conjuncts(e):
+    if isinstance(e, ir.BBool) and e.op == "AND":
+        return [c for a in e.args for c in _conjuncts(a)]
+    return [e]
+
+
+def index_probe(executor, node: ScanNode):
+    """The distribution-column equality constant when this scan can be
+    answered by the persistent point-lookup index (storage/pkindex.py —
+    the btree/hash-index analogue, columnar/README.md:176); else None."""
+    if not executor.settings.get("enable_point_lookup_index"):
+        return None
+    if node.filter is None or node.pruned_shards is None or \
+            len(node.pruned_shards) != 1:
+        return None
+    store = executor.store
+    if store.overlay is not None and (
+            any(t == node.rel.table for (t, _s) in store.overlay.records)):
+        # transaction-staged rows bypass the index; report ineligible
+        # HERE so the row-ceiling gate above doesn't assume an indexed
+        # answer and then fall through to an unbounded shard scan
+        return None
+    meta = executor.catalog.table(node.rel.table)
+    if meta.method != DistributionMethod.HASH:
+        return None
+    dcol = meta.distribution_column
+    if meta.schema.column(dcol).dtype not in (
+            DataType.INT32, DataType.INT64, DataType.DATE):
+        return None
+    for c in _conjuncts(node.filter):
+        if isinstance(c, ir.BCmp) and c.op == "=":
+            col, const = c.left, c.right
+            if not isinstance(col, ir.BCol):
+                col, const = c.right, c.left
+            if isinstance(col, ir.BCol) and isinstance(const, ir.BConst) \
+                    and col.column == dcol \
+                    and col.table == node.rel.table \
+                    and isinstance(const.value, (int, np.integer)):
+                return int(const.value)
+    return None
 
 
 def fast_path_shape(plan: QueryPlan, catalog) -> bool:
@@ -68,6 +112,8 @@ def try_execute_fast_path(executor, plan: QueryPlan, raw: bool):
     for node in walk_plan(plan.root):
         if not isinstance(node, ScanNode):
             continue
+        if index_probe(executor, node) is not None:
+            continue  # answered by the point index: O(matches), not O(shard)
         meta = executor.catalog.table(node.rel.table)
         shards = executor.catalog.table_shards(node.rel.table)
         if meta.method == DistributionMethod.HASH:
@@ -119,6 +165,32 @@ def _scan_host(executor, node: ScanNode):
     else:
         wanted = [shards[0]]
     colnames = [cid.split(".", 1)[1] for cid in node.columns]
+
+    value = index_probe(executor, node)
+    if value is not None and len(wanted) == 1:
+        from ..storage import pkindex
+
+        hits = pkindex.lookup(executor.store, node.rel.table,
+                              wanted[0].shard_id,
+                              meta.distribution_column, value)
+        if hits is not None:
+            if executor.counters is not None:
+                from ..stats import counters as sc
+
+                executor.counters.increment(sc.POINT_INDEX_LOOKUPS)
+            vals, mask, n = pkindex.read_rows(
+                executor.store, node.rel.table, wanted[0].shard_id,
+                colnames, hits)
+            cols = {cid: vals[cname]
+                    for cid, cname in zip(node.columns, colnames)}
+            nulls = {cid: ~mask[cname]
+                     for cid, cname in zip(node.columns, colnames)
+                     if not mask[cname].all()}
+            valid = np.ones(n, dtype=bool)
+            if n:  # the remaining (non-key) conjuncts still apply
+                valid = valid & np.broadcast_to(np.asarray(predicate_mask(
+                    node.filter, ColumnSource(cols, nulls), np)), (n,))
+            return _compress(cols, nulls, valid)
     chunk_filter = None
     if node.filter is not None:
         name_map = {c.name: executor.store.storage_column_name(

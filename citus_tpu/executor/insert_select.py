@@ -1,26 +1,32 @@
 """INSERT .. SELECT execution modes.
 
-The reference plans INSERT..SELECT three ways (pushdown / repartition /
-pull-to-coordinator — /root/reference/src/backend/distributed/planner/
+The reference plans INSERT..SELECT as pushdown / repartition /
+pull-to-coordinator (/root/reference/src/backend/distributed/planner/
 insert_select_planner.c:1-60, executor/repartition_executor.c:1-40,
 README throughput: ~100M / ~10M / ~1M rows/s respectively).  Here the
-source SELECT always runs as one device program; the difference is how
-results reach the target shards:
+source SELECT always runs as one device program; the modes differ in
+how results reach the target shards:
 
-* colocated  — the source plan's output distribution already matches the
-  target's sharding on the inserted distribution column (no cross-device
-  data movement is implied by the write).
-* repartition — the source's distribution differs; rows cross shard
-  boundaries on the way in.
-* pull       — legacy row-materializing fallback (kept only for shapes
-  the raw array path cannot express).
+* colocated — the source plan's output distribution already matches the
+  target's sharding on the inserted distribution column: the raw result
+  is sliced per device and appended straight to that device's shard —
+  no hashing, no routing masks (the pushdown mode, where the write
+  never crosses workers).
+* repartition, device-routed — when the target has one shard per device
+  and an integer distribution key, the plan gains an OUTPUT shuffle
+  (QueryPlan.output_repart → pack_by_target + all_to_all, the
+  worker_partition_query_result analogue,
+  partitioned_intermediate_results.c:108): rows arrive pre-partitioned
+  and the write slices per device like the colocated path.
+* repartition, host-routed — fallback (streamed sources, string
+  distribution keys, shard_count ≠ n_devices): a vectorized numpy
+  hash-route over the raw result arrays.
 
-Today colocated and repartition share one implementation — a vectorized
-hash route over the raw result arrays (numpy, no per-row Python) — so the
-mode currently selects reporting (stats counter / EXPLAIN), not a separate
-code path; a device-side partitioned write is the planned refinement.
+The reference's third mode (pull-to-coordinator) has no analogue: every
+result already materializes at the single controller, so "pull" and
+"repartition, host-routed" are the same path here.
 
-Both array modes use the executor's raw results: STRING columns stay
+All modes use the executor's raw results: STRING columns stay
 dictionary codes (translated dictionary→dictionary by a vectorized LUT)
 and DATE columns stay day numbers — no decode→parse round trip.
 """
@@ -100,8 +106,17 @@ def execute_insert_select(session, stmt):
                 f"INSERT..SELECT arity mismatch: {len(columns)} target "
                 f"columns, {len(plan.host_select)} select items")
         mode = choose_mode(session, plan, meta, columns)
+        if mode == "repartition":
+            rp = _plan_output_repart(session, plan, meta, columns)
+            if rp is not None:
+                plan.output_repart = rp
         result = session.executor.execute_plan(plan, raw=True)
-        n = _write_result(session, meta, columns, result, mode)
+        if plan.output_repart is not None and result.device_rows is None:
+            # source streamed (or order disturbed): rows were not
+            # device-partitioned end-to-end — host routing below
+            plan.output_repart = None
+        n = _write_result(session, meta, columns, result, mode,
+                          device_routed=plan.output_repart is not None)
         stats = getattr(session, "stats", None)
         if stats is not None:
             from ..stats import counters as sc
@@ -195,6 +210,40 @@ def _target_arrays(session, meta, columns, result):
     return typed, validity
 
 
+def _plan_output_repart(session, plan: QueryPlan, meta, columns):
+    """(shard_count, placement, bounds, key_expr) when the repartition
+    write can route ON DEVICE: hash-distributed (non-streamed) source,
+    one target shard per device, and a non-string distribution key whose
+    source expression the device program outputs.  None → host route."""
+    from ..catalog import DistributionMethod as DM
+
+    if meta.method != DM.HASH or plan.root.dist.kind != "hash":
+        return None
+    if _device_shard_map(session, meta) is None:
+        return None
+    if meta.schema.column(meta.distribution_column).dtype == \
+            DataType.STRING:
+        # device blocks hold per-source dictionary codes; the ingest
+        # token hash needs the string bytes — host route
+        return None
+    try:
+        di = columns.index(meta.distribution_column)
+    except ValueError:
+        return None
+    key_expr, _name = plan.host_select[di]
+    # the key must be computable from the device block alone
+    for n_ in ir.walk(key_expr):
+        if isinstance(n_, ir.BAgg):
+            return None
+    from ..planner.plan import table_placement
+
+    placement = table_placement(session.catalog, meta.name,
+                                session.n_devices)
+    bounds = tuple(session.catalog.shard_mins(meta.name))
+    shards = session.catalog.table_shards(meta.name)
+    return (len(shards), placement, bounds, key_expr)
+
+
 def _device_shard_map(session, meta):
     """device → shard_id when each device holds EXACTLY one shard of the
     target (the 1:1 layout where colocated writes need no hashing at
@@ -210,7 +259,8 @@ def _device_shard_map(session, meta):
     return {dev: shards[i].shard_id for i, dev in enumerate(placement)}
 
 
-def _write_result(session, meta, columns, result, mode="repartition") -> int:
+def _write_result(session, meta, columns, result, mode="repartition",
+                  device_routed: bool = False) -> int:
     n = result.row_count
     if n == 0:
         return 0
@@ -222,7 +272,8 @@ def _write_result(session, meta, columns, result, mode="repartition") -> int:
     table = meta.name
     try:
         dev_map = (_device_shard_map(session, meta)
-                   if mode == "colocated" and result.device_rows
+                   if (mode == "colocated" or device_routed)
+                   and result.device_rows
                    else None)
         if dev_map is not None:
             # COLOCATED fast path: rows are already partitioned exactly

@@ -100,12 +100,27 @@ class Executor:
         # succeeded: a query whose first run needed overflow/dense
         # retries starts warm runs from the converged sizes instead of
         # re-paying the retry executions.  Keyed by walk INDEX, not node
-        # id — every execution builds a fresh QueryPlan instance
-        self._caps_memo: dict = {}
+        # id — every execution builds a fresh QueryPlan instance.
+        # Persisted under the data dir: a NEW session starts from the
+        # converged/tightened sizes instead of re-paying the feedback
+        # recompile (a stale entry self-heals via overflow-retry)
+        self._caps_memo: dict = self._load_caps_memo()
+        # fingerprints already tightened by capacity feedback: tighten at
+        # most ONCE per plan shape, or generic (prepared) plans would
+        # recompile on every parameter value's slightly different actuals
+        self._tightened_fps: set = set()
 
     # ------------------------------------------------------------------
     def execute_plan(self, plan: QueryPlan, raw: bool = False) -> ResultSet:
         from .fastpath import try_execute_fast_path
+
+        # cross-session read-committed visibility: another session over
+        # this data_dir may have committed since our manifest was cached
+        # (one stat() per scanned table; writers refresh under the DML
+        # lock, this is the readers' counterpart)
+        for node in walk_plan(plan.root):
+            if isinstance(node, ScanNode):
+                self.store.refresh_if_stale(node.rel.table)
 
         fast = try_execute_fast_path(self, plan, raw)
         if fast is not None:
@@ -123,9 +138,12 @@ class Executor:
         topk_sig = (plan.device_topk, tuple(
             (repr(e), d, nf) for e, d, nf in plan.host_order_by)
             if plan.device_topk is not None else ())
+        orp = plan.output_repart
+        orp_sig = (None if orp is None
+                   else (orp[0], orp[1], orp[2], repr(orp[3])))
         fingerprint = (node_fingerprint(plan.root), plan.n_devices,
                        str(compute_dtype), feeds_signature(plan, feeds),
-                       topk_sig)
+                       topk_sig, orp_sig)
         memo = self._caps_memo.get(fingerprint)
         caps = (self._caps_from_order(plan, memo) if memo is not None
                 else self._initial_capacities(plan, feeds))
@@ -141,15 +159,26 @@ class Executor:
 
     # ------------------------------------------------------------------
     def run_with_retry(self, plan: QueryPlan, feeds, caps: Capacities,
-                       fingerprint, compute_dtype):
+                       fingerprint, compute_dtype, allow_tighten=True):
         """Compile (or fetch cached) + execute + overflow-retry loop.
 
         Shared by the resident-feed path and the streamed (batched)
         path.  Returns (packed, out_meta, converged_caps, retries);
         converged capacities are memoized under `fingerprint` whenever a
-        retry occurred so later executions start warm."""
+        retry occurred so later executions start warm.
+
+        Capacity feedback (the adaptive-executor move,
+        adaptive_executor.c:962, done the static-shape way): a clean
+        execution whose recorded stage actuals sit far below their
+        buffers tightens the capacities to actual×slack, recompiles
+        once, and memoizes — warm executions then run with near-actual
+        buffers even where the planner's estimate was 10× off (join
+        selectivities over correlated columns are statically
+        unestimable).  An over-tightened buffer (data changed) simply
+        overflows and regrows through the normal retry path."""
         limit = self.settings.get("max_plan_buffer_bytes")
         retries = 0
+        tightened = False
         while True:
             if limit:
                 est = _plan_buffer_bytes(plan, caps)
@@ -165,10 +194,10 @@ class Executor:
             if entry is None:
                 compiler = PlanCompiler(plan, self.mesh, feeds, caps,
                                         compute_dtype)
-                fn, feed_arrays, out_meta = compiler.build()
-                self.plan_cache.put(key, (fn, out_meta))
+                fn, feed_arrays, out_meta, stage_keys = compiler.build()
+                self.plan_cache.put(key, (fn, out_meta, stage_keys))
             else:
-                fn, out_meta = entry
+                fn, out_meta, stage_keys = entry
                 feed_arrays = flatten_feed_arrays(plan, feeds,
                                                   compute_dtype)
             # two device→host transfers total: the bit-packed output block
@@ -186,14 +215,26 @@ class Executor:
                 if "remote_compile" not in str(e):
                     raise
                 packed, overflow = jax.device_get(fn(*feed_arrays))
-            ov = np.asarray(overflow).reshape(-1, 2).sum(axis=0)
-            cap_overflow, dense_oob = int(ov[0]), int(ov[1])
+            ov = np.asarray(overflow).reshape(-1, 2 + len(stage_keys))
+            cap_overflow = int(ov[:, 0].sum())
+            dense_oob = int(ov[:, 1].sum())
             if cap_overflow == 0 and dense_oob == 0:
-                if retries:
-                    if len(self._caps_memo) > 512:
-                        self._caps_memo.clear()
-                    self._caps_memo[fingerprint] = \
-                        self._caps_to_order(plan, caps)
+                if allow_tighten and not tightened and \
+                        fingerprint not in self._tightened_fps and \
+                        self.settings.get("enable_capacity_feedback"):
+                    if len(self._tightened_fps) > 512:
+                        self._tightened_fps.clear()
+                    self._tightened_fps.add(fingerprint)
+                    tight = self._tighten_caps(
+                        plan, caps, stage_keys,
+                        ov[:, 2:].max(axis=0) if len(stage_keys) else [])
+                    if tight is not None:
+                        caps = tight
+                        tightened = True
+                        self._memoize_caps(fingerprint, plan, caps)
+                        continue  # recompile tight + re-execute
+                if retries or tightened:
+                    self._memoize_caps(fingerprint, plan, caps)
                 return packed, out_meta, caps, retries
             retries += 1
             from ..utils.faultinjection import fault_point
@@ -225,9 +266,92 @@ class Executor:
                      for k, v in fresh.agg_out.items()},
                     dense_off=True,
                     scan_out={k: max(v, caps.scan_out.get(k, 0))
-                              for k, v in fresh.scan_out.items()})
+                              for k, v in fresh.scan_out.items()},
+                    output_repart=max(fresh.output_repart or 0,
+                                      caps.output_repart or 0) or None)
             if cap_overflow:
                 caps = caps.grown(cap_overflow)
+
+    # ------------------------------------------------------------------
+    CAPS_MEMO_VERSION = 3  # bump when capacity semantics change
+
+    def _memo_path(self) -> str:
+        import os
+
+        return os.path.join(self.store.data_dir, "caps_memo.pkl")
+
+    def _load_caps_memo(self) -> dict:
+        import pickle
+
+        try:
+            with open(self._memo_path(), "rb") as f:
+                obj = pickle.load(f)
+            if obj.get("version") == self.CAPS_MEMO_VERSION:
+                return obj["memo"]
+        except Exception:
+            pass
+        return {}
+
+    def _memoize_caps(self, fingerprint, plan: QueryPlan,
+                      caps: Capacities) -> None:
+        import os
+        import pickle
+
+        if len(self._caps_memo) > 512:
+            self._caps_memo.clear()
+        self._caps_memo[fingerprint] = self._caps_to_order(plan, caps)
+        try:
+            tmp = self._memo_path() + ".tmp"
+            with open(tmp, "wb") as f:
+                pickle.dump({"version": self.CAPS_MEMO_VERSION,
+                             "memo": self._caps_memo}, f)
+            os.replace(tmp, self._memo_path())
+        except Exception:
+            pass  # persistence is best-effort; in-memory memo suffices
+
+    # ------------------------------------------------------------------
+    # feedback sizing: actual×slack, with headroom so equal-sized reruns
+    # never re-overflow; only shrink when the win is material (a
+    # recompile costs real time on remote-attached chips).  The
+    # threshold is PER KIND: repartition/agg_out are pure buffer sizes
+    # (tightening is free — smaller shuffles and slices), but
+    # scan_out/join_out tightening can INTRODUCE a compaction pass that
+    # costs ~(n_cols+1) output-sized gathers — and TPU gathers run at
+    # ~80M elem/s (bench_kernels), so a 60M→42M "win" measured 2.5 s
+    # SLOWER on Q3 SF10.  Compaction must shrink ≥3× to pay for itself.
+    TIGHTEN_SLACK = 1.3
+    TIGHTEN_THRESHOLD = {"repartition": 0.85, "agg_out": 0.85,
+                         "scan_out": 1.0 / 3.0, "join_out": 1.0 / 3.0}
+
+    def _tighten_caps(self, plan: QueryPlan, caps: Capacities,
+                      stage_keys, actuals) -> Capacities | None:
+        """Shrink buffers whose recorded actual row counts sit far below
+        their current size.  stage_keys entries are (walk_index, kind,
+        width); actuals is the per-stage max over devices.  Returns the
+        tightened Capacities, or None when nothing material changed."""
+        from .cache import plan_order
+
+        rev = {i: nid for nid, i in plan_order(plan).items()}
+        new = {"repartition": dict(caps.repartition),
+               "join_out": dict(caps.join_out),
+               "agg_out": dict(caps.agg_out),
+               "scan_out": dict(caps.scan_out)}
+        changed = False
+        for (widx, kind, width), actual in zip(stage_keys, actuals):
+            nid = rev.get(widx)
+            if nid is None:
+                continue
+            table = new[kind]
+            cur = table.get(nid, width)
+            t = _round_cap(int(int(actual) * self.TIGHTEN_SLACK) + 128)
+            if t < cur * self.TIGHTEN_THRESHOLD[kind]:
+                table[nid] = t
+                changed = True
+        if not changed:
+            return None
+        return Capacities(new["repartition"], new["join_out"],
+                          new["agg_out"], caps.dense_off,
+                          new["scan_out"], caps.output_repart)
 
     # ------------------------------------------------------------------
     @staticmethod
@@ -241,7 +365,8 @@ class Executor:
                 {order[k]: v for k, v in caps.join_out.items()},
                 {order[k]: v for k, v in caps.agg_out.items()},
                 caps.dense_off,
-                {order[k]: v for k, v in caps.scan_out.items()})
+                {order[k]: v for k, v in caps.scan_out.items()},
+                caps.output_repart)
 
     @staticmethod
     def _caps_from_order(plan: QueryPlan, memo: tuple) -> Capacities:
@@ -252,7 +377,8 @@ class Executor:
                           {rev[i]: v for i, v in memo[1].items()},
                           {rev[i]: v for i, v in memo[2].items()},
                           memo[3],
-                          {rev[i]: v for i, v in memo[4].items()})
+                          {rev[i]: v for i, v in memo[4].items()},
+                          memo[5] if len(memo) > 5 else None)
 
     def _initial_capacities(self, plan: QueryPlan, feeds,
                             dense_off: bool = False) -> Capacities:
@@ -278,12 +404,14 @@ class Executor:
                 # size by the filtered estimate, not the table (1.5×
                 # slack over the uniform-assumption estimate; an
                 # under-estimate overflows and retries doubled, and the
-                # converged sizes are memoized per plan fingerprint)
+                # converged sizes are memoized per plan fingerprint).
+                # Compaction pays ~(n_cols+1) output-sized gathers at
+                # ~80M elem/s — only a ≥3× shrink is worth the pass
                 est = max(1, node.est_rows)
                 per_dev = (est if not feeds[id(node)].sharded
                            else -(-est // n_dev))
                 k = _round_cap(int(per_dev * 1.5) + 512)
-                if k < base * 0.8:
+                if k * 3 < base:
                     scan_out[id(node)] = k
                     return k
                 return base
@@ -325,7 +453,7 @@ class Executor:
                     if node.join_type == "inner" and node.residual is None:
                         est = max(1, node.est_rows)
                         k = _round_cap(int(-(-est // n_dev) * 1.5) + 512)
-                        if k < out * 0.8:
+                        if k * 3 < out:  # same ≥3× compaction economics
                             out = k
                     join_out[id(node)] = out
                     return out
@@ -391,8 +519,15 @@ class Executor:
                 return in_cap
             raise ExecutionError(f"unknown node {type(node).__name__}")
 
-        cap_of(plan.root)
-        return Capacities(repart, join_out, agg_out, dense_off, scan_out)
+        root_cap = cap_of(plan.root)
+        out_rp = None
+        if plan.output_repart is not None:
+            # balanced-hash expectation with headroom; skew overflows
+            # and regrows through the normal retry path
+            out_rp = _round_cap(
+                int(-(-root_cap // n_dev) * repart_factor) + 256)
+        return Capacities(repart, join_out, agg_out, dense_off, scan_out,
+                          out_rp)
 
     # ------------------------------------------------------------------
     def _host_combine(self, plan: QueryPlan, cols, nulls, valid,

@@ -509,8 +509,13 @@ def try_execute_streamed(executor, plan: QueryPlan, raw: bool):
                 caps = (executor._caps_from_order(plan, memo)
                         if memo is not None
                         else executor._initial_capacities(plan, feeds))
+            # no feedback tightening mid-stream: batches share one
+            # compiled program, and per-batch actuals vary — tightening
+            # on batch 1 would risk a recompile-overflow-regrow cycle
+            # on a later, fuller batch
             packed, out_meta, caps, r = executor.run_with_retry(
-                plan, feeds, caps, fingerprint, compute_dtype)
+                plan, feeds, caps, fingerprint, compute_dtype,
+                allow_tighten=False)
             retries_total += r
             cols, nulls, valid = unpack_outputs(packed, out_meta)
             rows_scanned += int(np.asarray(valid).size)

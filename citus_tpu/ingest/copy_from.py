@@ -142,6 +142,13 @@ def _ingest_batch(session, table: str, columns: list[str],
             for sid in sorted(s.shard_id for i, s in enumerate(shards)
                               if bool((shard_idx == i).any())):
                 session.locks.acquire(lock_txid, (table, sid))
+            # a split in ANOTHER session commits catalog.json while we
+            # wait on its shard lock — without adopting it here the
+            # write would land in the dropped parent shard and vanish
+            import os as _os
+
+            session.catalog.maybe_reload(
+                _os.path.join(session.data_dir, "catalog.json"))
             if session.catalog.version == version:
                 break
             session.locks.release_all(lock_txid)

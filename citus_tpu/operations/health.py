@@ -44,8 +44,27 @@ def probe_node(session, node) -> bool:
             out = jax.device_put(jnp.ones((), jnp.int32), devices[idx])
             if int(out) != 1:
                 return False
-        # storage probe: the shared store must answer metadata reads
-        session.catalog.active_nodes()
+        # storage probe: an actual DISK read of a shard directory this
+        # node hosts (r4 advisor: an in-memory catalog read can never
+        # fail, making the storage leg vacuous for non-device nodes)
+        import os
+
+        probed = False
+        for p in session.catalog.placements.values():
+            if p.node_id != node.node_id or p.shard_state != "active":
+                continue
+            shard = session.catalog.shards.get(p.shard_id)
+            if shard is None:
+                continue
+            sdir = session.store.shard_dir(shard.table_name, p.shard_id)
+            if os.path.isdir(sdir):  # shard dirs materialize lazily
+                os.listdir(sdir)     # raises on unreadable storage
+                probed = True
+                break
+        if not probed:
+            # node hosts no materialized shards (spare): the store root
+            # itself must exist and answer a directory read
+            os.listdir(session.store.data_dir)
         return True
     except Exception:
         return False

@@ -97,6 +97,10 @@ def split_shard_by_split_points(session, shard_id: int,
                            for t in group_tables):
             locks.acquire(lock_txid, (t, p))
         for t in group_tables:
+            # adopt rows another session committed before we locked —
+            # the rewrite must read the CURRENT manifest, not this
+            # session's cache, or those rows vanish with the parent
+            store.refresh(t)
             _rewrite_shard(session, t, plan[t]["parent"],
                            plan[t]["children"], los, his)
         # --- atomic commit point: one catalog mutation + save ---

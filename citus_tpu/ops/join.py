@@ -223,20 +223,30 @@ def dense_unique_lookup(build_key: jnp.ndarray,
     O(m log m) argsort per execution, which dominated multi-join
     queries at SF1 on real TPUs).
 
-    Returns (bidx [N], counts [N], oob_count).  counts carries per-probe
-    match counts INCLUDING duplicates, so the caller's existing
-    stale-uniqueness protocol (counts > 1 → dense_oob → retry on the
-    general expansion path) is unchanged; duplicate build rows also add
-    to oob so the retry always fires even if no probe hits them."""
+    Returns (bidx [N], counts [N], oob_count).  Probing costs ONE gather
+    per probe row: random HBM gathers are the measured wall of this path
+    (~80M probes/s on v5e — 2 gathers over a 60M-entry directory put
+    TPC-H Q3's SF10 probe stage at 1 s alone), so per-probe match counts
+    come from the directory hit itself (0/1) rather than a second
+    per_slot gather.  Duplicate build keys — the stale-uniqueness case —
+    are detected BUILD-side: scatter-then-gather-back over the m build
+    rows; overwritten rows read back a different index.  dups feed oob
+    so the caller's retry-on-general-path protocol still always fires."""
     m = build_key.shape[0]
-    slot, per_slot, oob = _dense_slots(build_key, build_matchable, base,
-                                       extent)
-    dup = jnp.maximum(per_slot - 1, 0).sum().astype(jnp.int64)
+    idx = build_key.astype(jnp.int64) - jnp.int64(base)
+    inb = build_matchable & (idx >= 0) & (idx < extent)
+    oob = (build_matchable & ~inb).sum().astype(jnp.int64)
+    slot = jnp.where(inb, idx, extent).astype(jnp.int32)
+    iota_m = jnp.arange(m, dtype=jnp.int32)
     directory = jnp.full(extent, m, jnp.int32).at[slot].set(
-        jnp.arange(m, dtype=jnp.int32), mode="drop")
+        iota_m, mode="drop")
+    dup = (inb & (jnp.minimum(directory[jnp.minimum(slot, extent - 1)], m)
+                  != iota_m)).sum().astype(jnp.int64)
     pin, pc = _probe_slots(probe_key, base, extent)
-    bidx = jnp.minimum(directory[pc], m - 1)
-    counts = jnp.where(pin, per_slot[pc], 0)
+    raw = directory[pc]
+    found = pin & (raw != m)
+    bidx = jnp.minimum(raw, m - 1)
+    counts = found.astype(jnp.int32)
     return bidx, counts, oob + dup
 
 

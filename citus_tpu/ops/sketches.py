@@ -13,11 +13,14 @@ the per-row work on device as plain grouped aggregation:
   registers into the cardinality estimate; the final fold is itself
   expressed as level-2 aggregates + host math, so everything stays in
   one plan.
-* approx_percentile — bounded histogram.  Device computes
-  ``group by value_bucket`` counts over the column's EXACT min/max from
-  manifest statistics; the host interpolates the quantile from the
-  cumulative histogram.  Error is bounded by one bucket width in value
-  space (t-digest bounds rank-space instead — documented difference).
+* approx_percentile — DDSketch.  Device computes
+  ``group by (G…, dd_bucket(x))`` counts; the fixed log-domain bucket
+  mapping makes per-shard sketches merge by count addition through the
+  ordinary aggregate split, and the host folds (key, count) pairs into
+  quantiles with a RELATIVE error bound α = (γ-1)/(γ+1) ≈ 1%
+  (t-digest bounds rank space instead — documented difference; DDSketch
+  was chosen because bucketing is a pure map, TPU-friendly, where
+  t-digest's centroid merge is sequential).
 
 This module holds the constants + host estimators; the device
 expressions live in planner IR (BHllBucket / BHllRho) and the plan
@@ -69,34 +72,66 @@ def hll_estimate(n_buckets: np.ndarray, sum_exp2neg: np.ndarray,
     return np.rint(out).astype(np.int64)
 
 
-def histogram_quantile(bucket_ids: np.ndarray, counts: np.ndarray,
-                       q: float, lo: float, width: float,
-                       n_buckets: int) -> float | None:
-    """Quantile from per-bucket counts (bucket = floor((x-lo)/width),
-    clipped to [0, n_buckets-1]); linear interpolation inside the
-    selected bucket.  None for an empty input."""
-    if len(bucket_ids) == 0:
+# -- DDSketch quantiles ---------------------------------------------------
+# Log-domain buckets (DDSketch, Masson/Lee/Rigollet VLDB 2019): bucket
+# k(x) = ceil(log_γ x) for x > 0, mirrored for negatives, one zero
+# bucket for |x| ≤ DD_EPS.  Guarantee: the returned quantile x̂
+# satisfies |x̂ - x_q| ≤ α·|x_q| with α = (γ-1)/(γ+1) — RELATIVE error,
+# independent of the data's range, so one outlier cannot stretch every
+# bucket (the failure mode of the min/max linear histogram this
+# replaced; r4 VERDICT weak #5).  The buckets are a FIXED value→key
+# mapping, so per-shard sketches merge by adding counts — they ride the
+# grouped-aggregate split (groups = (G…, key)) and psum/shuffle combine
+# exactly like the HLL registers above.  γ = 1.02 → α ≈ 1.0%, ~3.1k
+# buckets per sign over |x| ∈ [1e-9, 1e18].
+DD_GAMMA = 1.02
+DD_EPS = 1e-9
+DD_ALPHA = (DD_GAMMA - 1.0) / (DD_GAMMA + 1.0)
+DD_LOG_GAMMA = math.log(DD_GAMMA)
+DD_KMIN = math.ceil(math.log(DD_EPS) / DD_LOG_GAMMA)   # ≈ -1046
+DD_KMAX = math.ceil(math.log(1e18) / DD_LOG_GAMMA)     # ≈  2094
+DD_NKEYS = 2 * (DD_KMAX - DD_KMIN + 1) + 1
+
+
+def dd_bucket(v, xp=np):
+    """Signed DDSketch bucket key; monotone in v (sortable).  Shared by
+    the host evaluator (xp=numpy) and the device path (xp=jax.numpy —
+    float32 log rounds bucket boundaries by at most one bucket, still
+    within the α bound's order)."""
+    av = xp.abs(v)
+    k = xp.ceil(xp.log(xp.maximum(av, DD_EPS)) / DD_LOG_GAMMA)
+    k = xp.clip(k, DD_KMIN, DD_KMAX) - (DD_KMIN - 1)
+    sign = xp.where(v < 0, -1, 1)
+    return xp.where(av <= DD_EPS, 0,
+                    sign * k.astype(xp.int32)).astype(xp.int32)
+
+
+def dd_value(key: int) -> float:
+    """Representative (log-midpoint) value of a bucket key."""
+    if key == 0:
+        return 0.0
+    k = abs(int(key)) + DD_KMIN - 1
+    v = 2.0 * (DD_GAMMA ** k) / (DD_GAMMA + 1.0)
+    return v if key > 0 else -v
+
+
+def dd_quantile(keys: np.ndarray, counts: np.ndarray,
+                q: float) -> float | None:
+    """Quantile from (bucket key, count) pairs; None on empty input.
+    Keys are monotone in value, so rank selection is a sort + cumsum."""
+    keys = np.asarray(keys, dtype=np.int64)
+    counts = np.asarray(counts, dtype=np.int64)
+    if keys.size == 0:
         return None
-    order = np.argsort(bucket_ids)
-    b = np.asarray(bucket_ids, dtype=np.int64)[order]
-    c = np.asarray(counts, dtype=np.int64)[order]
+    order = np.argsort(keys)
+    k = keys[order]
+    c = counts[order]
     total = int(c.sum())
     if total == 0:
         return None
-    target = q * total
+    # rank of the q-quantile (nearest-rank, 1-based)
+    target = max(1, int(math.ceil(q * total)))
     cum = np.cumsum(c)
     i = int(np.searchsorted(cum, target, side="left"))
-    i = min(i, len(b) - 1)
-    prev = int(cum[i - 1]) if i > 0 else 0
-    inside = (target - prev) / max(int(c[i]), 1)
-    inside = min(max(inside, 0.0), 1.0)
-    return float(lo + (int(b[i]) + inside) * width)
-
-
-def percentile_bucket_params(vmin: float, vmax: float,
-                             n_buckets: int = 8192) -> tuple[float, float]:
-    """(lo, width) for the value-space histogram; degenerate ranges get
-    width 1 so every value lands in bucket 0."""
-    if not math.isfinite(vmin) or not math.isfinite(vmax) or vmax <= vmin:
-        return float(vmin), 1.0
-    return float(vmin), (float(vmax) - float(vmin)) / n_buckets
+    i = min(i, len(k) - 1)
+    return dd_value(int(k[i]))

@@ -554,15 +554,22 @@ class Binder:
                    allow_agg: bool) -> ir.BExpr:
         if e.window is not None or e.name in self._WINDOW_ONLY:
             return self._bind_window(e, scope, allow_agg)
+        if e.name == "__dd_bucket":
+            # DDSketch bucket key (internal marker emitted by the
+            # session's approx_percentile rewrite)
+            if len(e.args) != 1:
+                raise PlanningError("__dd_bucket takes one argument")
+            arg = self.bind_expr(e.args[0], scope, allow_agg=False)
+            return ir.BDDBucket(arg)
         if e.name in ast.AGGREGATE_FUNCS:
             if not allow_agg:
                 raise PlanningError("aggregate not allowed here")
             if e.name == "approx_percentile":
-                # the session rewrites the supported (global) shape into
-                # a histogram pre-pass before binding ever sees it
+                # the session rewrites supported shapes into a DDSketch
+                # bucket pre-pass before binding ever sees the call
                 raise UnsupportedQueryError(
-                    "approx_percentile is supported only as a global "
-                    "aggregate (no GROUP BY) over a plain column")
+                    "approx_percentile is supported over plain columns "
+                    "with plain-column GROUP BY keys")
             if e.name == "approx_count_distinct":
                 if len(e.args) != 1 or e.star:
                     raise PlanningError(

@@ -190,6 +190,22 @@ class BHllBucket(BExpr):
 
 
 @dataclass(frozen=True)
+class BDDBucket(BExpr):
+    """DDSketch log-domain bucket key of the operand (signed, monotone
+    in value; ops/sketches.py dd_bucket).  Grouping by it IS the
+    mergeable quantile sketch — per-shard bucket counts combine by
+    addition through the ordinary aggregate split, the way BHllBucket
+    registers merge by max.  NULL operands propagate (the NULL bucket
+    group is dropped by the percentile rewrite — PG semantics)."""
+
+    operand: BExpr
+    dtype: DataType = DataType.INT32
+
+    def __str__(self):
+        return f"dd_bucket({self.operand})"
+
+
+@dataclass(frozen=True)
 class BHllRho(BExpr):
     """HyperLogLog rank: 1 + count-of-leading-zeros of the remaining
     32-p hash bits (capped at 32-p+1 when they are all zero)."""
@@ -285,7 +301,7 @@ def children(e: BExpr) -> tuple:
     if isinstance(e, BBool):
         return e.args
     if isinstance(e, (BIsNull, BCast, BExtract, BStrRemap, BMath,
-                      BHllBucket, BHllRho)):
+                      BHllBucket, BHllRho, BDDBucket)):
         return (e.operand,)
     if isinstance(e, BInConst):
         return (e.operand,)

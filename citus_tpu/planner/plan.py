@@ -241,6 +241,13 @@ class QueryPlan:
     # device-side analogue of the reference's worker-side LIMIT pushdown,
     # planner/multi_logical_optimizer.c worker limit handling)
     device_topk: Optional[int] = None
+    # INSERT..SELECT repartition mode: route the final block to the
+    # TARGET table's sharding on device (pack_by_target + all_to_all —
+    # the worker_partition_query_result analogue,
+    # partitioned_intermediate_results.c:108) so the host writes
+    # per-device slices instead of re-hashing rows on numpy.
+    # (shard_count, placement, bounds, key_expr over root outputs)
+    output_repart: Optional[tuple] = None
 
 
 class DistributedPlanner:
@@ -1370,6 +1377,15 @@ class DistributedPlanner:
                         g.operand.table, g.operand.column, g.operand.dtype)
                     if arg_ndv:
                         ndv = min(ndv, arg_ndv)
+            if isinstance(g, ir.BDDBucket):
+                from ..ops.sketches import DD_NKEYS
+
+                ndv = DD_NKEYS
+                if isinstance(g.operand, ir.BCol) and g.operand.table:
+                    arg_ndv = self.stats.column_ndv(
+                        g.operand.table, g.operand.column, g.operand.dtype)
+                    if arg_ndv:
+                        ndv = min(ndv, arg_ndv)
             if ndv is None or ndv <= 0:
                 return 0
             est *= ndv
@@ -1477,8 +1493,14 @@ def _hll_estimate_expr() -> ir.BExpr:
     def c(v):
         return ir.BConst(float(v), F)
 
-    cnt = ir.BCast(ir.BCol("hcnt", DataType.INT64), F)
-    s = ir.BCol("hsum", F)
+    def coalesce0(e):
+        # over an EMPTY input the level-2 sum (and, defensively, count)
+        # is NULL; with both coalesced to 0 the linear-counting branch
+        # yields m·ln(m/m) = 0 — matching exact count(distinct) on empty
+        return ir.BCase(((ir.BIsNull(e), c(0.0)),), e, F)
+
+    cnt = coalesce0(ir.BCast(ir.BCol("hcnt", DataType.INT64), F))
+    s = coalesce0(ir.BCol("hsum", F))
     empty = ir.BArith("-", c(m), cnt, F)
     raw = ir.BArith("/", c(hll_alpha(HLL_M) * m * m),
                     ir.BArith("+", empty, s, F), F)
@@ -1522,6 +1544,8 @@ def _rebuild(e: ir.BExpr, new_children: list[ir.BExpr]) -> ir.BExpr:
         return ir.BHllBucket(new_children[0], e.p)
     if isinstance(e, ir.BHllRho):
         return ir.BHllRho(new_children[0], e.p)
+    if isinstance(e, ir.BDDBucket):
+        return ir.BDDBucket(new_children[0])
     if isinstance(e, ir.BExtract):
         return ir.BExtract(e.part, new_children[0])
     if isinstance(e, ir.BCase):

@@ -137,6 +137,7 @@ class Session:
         # BEFORE the outer binder sees the params; thread-local because
         # Session.execute supports concurrent callers)
         self._params_tls = threading.local()
+        self._view_tls = threading.local()  # view-expansion cycle guard
         from .executor.runner import Executor
         from .stats import SessionStats
 
@@ -177,6 +178,11 @@ class Session:
 
         result = None
         tenant_hits: list[tuple[str, object]] = []
+        # adopt another session's committed DDL (one stat per call);
+        # never mid-transaction — the open txn pinned its snapshot
+        if self.txn_manager.current is None:
+            self.catalog.maybe_reload(
+                os.path.join(self.data_dir, "catalog.json"))
         with self.stats.activity.track(sql):
             t0 = _time.perf_counter()
             for stmt in parse(sql):
@@ -281,6 +287,25 @@ class Session:
             return None
         if isinstance(stmt, ast.DropSequence):
             self.catalog.drop_sequence(stmt.name, stmt.if_exists)
+            self._save_catalog()
+            return None
+        if isinstance(stmt, ast.CreateView):
+            # validate the body against the CURRENT catalog before
+            # persisting (parse already checked syntax)
+            body = parse(stmt.sql)[0]
+            if not isinstance(body, (ast.Select, ast.SetOp)):
+                raise PlanningError("a view body must be a SELECT")
+            if stmt.columns and isinstance(body, ast.Select) and \
+                    len(stmt.columns) != len(body.items):
+                raise PlanningError(
+                    f"view {stmt.name!r} declares {len(stmt.columns)} "
+                    f"columns but its SELECT has {len(body.items)}")
+            self.catalog.create_view(stmt.name, stmt.sql, stmt.columns,
+                                     stmt.or_replace)
+            self._save_catalog()
+            return None
+        if isinstance(stmt, ast.DropView):
+            self.catalog.drop_view(stmt.name, stmt.if_exists)
             self._save_catalog()
             return None
         if isinstance(stmt, ast.AlterTable):
@@ -730,25 +755,41 @@ class Session:
             self.store.apply_dml(table, deletes, list(pending))
 
     @contextlib.contextmanager
-    def _dml_locks(self, table: str, shard_ids):
+    def _dml_locks(self, table: str, shards_fn):
         """Exclusive (table, shard) locks around a DML read-modify-apply
         window (AcquireExecutorShardLocksForExecution analogue,
         executor/distributed_execution_locks.c).  Transaction locks are
         held to COMMIT/ROLLBACK (2PL); autocommit locks release at
         statement end.  The deadlock victim's transaction rolls back
-        automatically, like the reference canceling the youngest backend."""
+        automatically, like the reference canceling the youngest backend.
+
+        `shards_fn` re-derives the target shard list from the CURRENT
+        catalog: a concurrent shard split commits its catalog while we
+        wait on the parent's lock, and writing via the pre-wait routing
+        would land rows in the dropped parent (lost).  The loop adopts
+        the on-disk catalog after acquiring and re-derives until stable;
+        locks are only ever ADDED (never released mid-transaction — 2PL),
+        stale ones release with the rest at statement/transaction end.
+        Yields the stable shard list."""
         from .transaction.clock import global_clock
         from .transaction.locks import DeadlockDetectedError
 
         txn = self.txn_manager.current
         txid = txn.txid if txn is not None else global_clock.now()
         try:
-            for sid in sorted(shard_ids):
-                self.locks.acquire(txid, (table, sid))
+            while True:
+                version = self.catalog.version
+                shards = shards_fn()
+                for sid in sorted(s.shard_id for s in shards):
+                    self.locks.acquire(txid, (table, sid))
+                self.catalog.maybe_reload(
+                    os.path.join(self.data_dir, "catalog.json"))
+                if self.catalog.version == version:
+                    break
             # see the latest committed state from sessions sharing this
             # data_dir (manifest cache may predate the lock wait)
             self.store.refresh(table)
-            yield
+            yield shards
         except DeadlockDetectedError:
             if txn is not None and self.txn_manager.current is txn:
                 self.txn_manager.rollback()
@@ -1077,6 +1118,28 @@ class Session:
             if fi.name in cte_scope:
                 return ast.TableRef(cte_scope[fi.name],
                                     fi.alias or fi.name)
+            view = self.catalog.views.get(fi.name)
+            if view is not None:
+                # expand like a derived table: materialize the view body
+                # (fresh scope — view bodies bind to base tables, never
+                # to the referencing statement's CTEs).  A thread-local
+                # stack guards against self/mutually-recursive views
+                # (creatable because CREATE VIEW only parses the body)
+                stack = getattr(self._view_tls, "stack", None)
+                if stack is None:
+                    stack = self._view_tls.stack = []
+                if fi.name in stack:
+                    raise PlanningError(
+                        f"infinite recursion detected in view "
+                        f"{fi.name!r}")
+                stack.append(fi.name)
+                try:
+                    body = parse(view["sql"])[0]
+                    temp = self._query_to_temp(body, cleanup, {},
+                                               tuple(view["columns"]))
+                finally:
+                    stack.pop()
+                return ast.TableRef(temp, fi.alias or fi.name)
             return fi
         if isinstance(fi, ast.SubqueryRef):
             temp = self._query_to_temp(fi.query, cleanup, cte_scope)
@@ -1093,36 +1156,44 @@ class Session:
 
     def _rewrite_approx_percentile(self, sel: ast.Select, cleanup,
                                    cte_scope) -> ast.Select:
-        """Global approx_percentile(col, q) → bounded-histogram pre-pass.
+        """approx_percentile(col, q) → DDSketch bucket pre-pass.
 
-        The device runs `group by value_bucket → count(*)` over the same
-        FROM/WHERE (bucket bounds come from EXACT manifest min/max
-        statistics), the host interpolates the quantile from the
-        cumulative histogram (ops/sketches.py), and the call site gets
-        the value as a constant wrapped in max() so aggregate shape is
-        preserved (one row, NULL over an empty input).  Reference:
-        percentile→tdigest rewrite, multi_logical_optimizer.c:286.
-        Grouped approx_percentile is rejected (binder raises)."""
+        The device runs ``group by (G…, dd_bucket(col)) → count(*)``
+        over the same FROM/WHERE — the log-domain buckets ARE the
+        mergeable quantile sketch (per-shard counts add through the
+        ordinary aggregate split, the way HLL registers merge by max),
+        with a RELATIVE error bound α = (γ-1)/(γ+1) ≈ 1% that one
+        outlier cannot degrade (ops/sketches.py).  The host folds the
+        per-(group, bucket) counts into quantile values:
+
+        * global: the value replaces the call as a constant wrapped in
+          max() — one row, NULL over an empty input.
+        * GROUP BY: per-group values materialize as a temp reference
+          table (g…, pctl) joined back into the query on the group
+          keys; the call becomes max(pctl) over the (unique-per-group)
+          joined column.
+
+        Reference: percentile → worker tdigest + coordinator merge,
+        multi_logical_optimizer.c:2046."""
         from .planner.decorrelate import _map_children
-        from .ops.sketches import (
-            histogram_quantile,
-            percentile_bucket_params,
-        )
+        from .ops.sketches import dd_quantile
 
         calls = [n for it in sel.items for n in ast.walk_expr(it.expr)
                  if isinstance(n, ast.FuncCall)
                  and n.name == "approx_percentile"]
         if not calls:
             return sel
-        if sel.group_by or sel.distinct:
+        if sel.distinct:
             raise UnsupportedQueryError(
-                "approx_percentile is supported only as a global "
-                "aggregate (no GROUP BY)")
-        N_BUCKETS = 8192
-        repl: dict[ast.FuncCall, ast.Expr] = {}
+                "approx_percentile cannot combine with SELECT DISTINCT")
+        group_keys = list(sel.group_by)
+        for g in group_keys:
+            if not isinstance(g, ast.ColumnRef):
+                raise UnsupportedQueryError(
+                    "approx_percentile with GROUP BY requires plain "
+                    "column group keys")
+        parsed: list[tuple[ast.FuncCall, ast.ColumnRef, float]] = []
         for call in calls:
-            if call in repl:
-                continue
             if call.window is not None or call.distinct or \
                     len(call.args) != 2:
                 raise UnsupportedQueryError(
@@ -1138,71 +1209,154 @@ class Session:
             if not isinstance(col, ast.ColumnRef):
                 raise UnsupportedQueryError(
                     "approx_percentile argument must be a plain column")
-            rng = self._column_range_for(col, sel, cte_scope)
-            if rng is None:
-                raise UnsupportedQueryError(
-                    f"approx_percentile: no min/max statistics for "
-                    f"{col}")
-            lo, width = percentile_bucket_params(rng[0], rng[1],
-                                                 N_BUCKETS)
-            # bucket = clip(int((col - lo) / width), 0, B-1)
-            bucket = ast.Cast(
-                ast.BinaryOp("/",
-                             ast.BinaryOp("-", col, ast.Literal(lo)),
-                             ast.Literal(width)), "bigint")
-            bucket = ast.CaseWhen(
-                ((ast.BinaryOp(">=", bucket,
-                               ast.Literal(N_BUCKETS)),
-                  ast.Literal(N_BUCKETS - 1)),),
-                bucket)
+            parsed.append((call, col, float(qlit.value)))
+
+        repl: dict[ast.FuncCall, ast.Expr] = {}
+        extra_from: list[ast.FromItem] = []
+        extra_where: list[ast.Expr] = []
+        # one pre-pass per distinct sketched column; every quantile over
+        # that column reads the same (group, bucket) counts
+        by_col: dict[ast.ColumnRef, list[tuple[ast.FuncCall, float]]] = {}
+        for call, col, q in parsed:
+            by_col.setdefault(col, []).append((call, q))
+        for col, wants in by_col.items():
+            bucket = ast.FuncCall("__dd_bucket", (col,))
+            g_items = tuple(ast.SelectItem(g, f"g{i}")
+                            for i, g in enumerate(group_keys))
             hist = ast.Select(
-                items=(ast.SelectItem(bucket, "hb"),
-                       ast.SelectItem(
-                           ast.FuncCall("count", (), star=True), "c")),
+                items=g_items + (
+                    ast.SelectItem(bucket, "hb"),
+                    ast.SelectItem(
+                        ast.FuncCall("count", (), star=True), "c")),
                 from_items=sel.from_items, where=sel.where,
-                group_by=(bucket,),
+                group_by=tuple(group_keys) + (bucket,),
                 # decorrelated EXISTS filters must apply here too
                 semi_joins=sel.semi_joins)
             inner = self._recursive_plan(hist, cleanup, cte_scope)
             result = self._execute_subselect(self._sub_params(inner))
+            nk = len(group_keys)
             # NULL column values form a NULL bucket group: percentile
             # ignores NULLs (PG semantics), so drop it
-            rows = [r for r in result.rows() if r[0] is not None]
-            value = histogram_quantile(
-                np.asarray([r[0] for r in rows], dtype=np.int64),
-                np.asarray([r[1] for r in rows], dtype=np.int64),
-                float(qlit.value), lo, width, N_BUCKETS)
-            repl[call] = ast.FuncCall("max", (ast.Literal(value),))
+            rows = [r for r in result.rows() if r[nk] is not None]
+            if not group_keys:
+                keys = np.asarray([r[0] for r in rows], dtype=np.int64)
+                cnts = np.asarray([r[1] for r in rows], dtype=np.int64)
+                for call, q in wants:
+                    repl[call] = ast.FuncCall(
+                        "max", (ast.Literal(dd_quantile(keys, cnts, q)),))
+                continue
+            # grouped: fold per group tuple.  Groups whose sketched
+            # column is ALL NULL appear only in the dropped NULL-bucket
+            # rows — they must still produce an output row (with a NULL
+            # percentile, PG semantics), so collect group tuples from
+            # the UNFILTERED result
+            per_group: dict[tuple, list[tuple[int, int]]] = {}
+            for r in rows:
+                per_group.setdefault(tuple(r[:nk]), []).append(
+                    (int(r[nk]), int(r[nk + 1])))
+            gtuples = []
+            seen_g = set()
+            for r in result.rows():
+                g = tuple(r[:nk])
+                if g not in seen_g:
+                    seen_g.add(g)
+                    gtuples.append(g)
+            pctls: list[list] = []  # per want, per group tuple
+            for call, q in wants:
+                vals = []
+                for g in gtuples:
+                    pairs = per_group.get(g)
+                    if not pairs:
+                        vals.append(None)  # all-NULL group
+                        continue
+                    keys = np.asarray([k for k, _ in pairs],
+                                      dtype=np.int64)
+                    cnts = np.asarray([c for _, c in pairs],
+                                      dtype=np.int64)
+                    vals.append(dd_quantile(keys, cnts, q))
+                pctls.append(vals)
+            key_dts = [_result_dtype(result, i) for i in range(nk)]
+            if DataType.STRING in key_dts:
+                # string group keys can't ride the temp join (cross-
+                # table string equality needs dictionary alignment);
+                # inline a CASE over the observed group values instead
+                if len(gtuples) > 1000:
+                    raise UnsupportedQueryError(
+                        "approx_percentile with string GROUP BY keys "
+                        "supports at most 1000 groups")
+                for j, (call, _q) in enumerate(wants):
+                    whens = []
+                    for gi, g in enumerate(gtuples):
+                        conds = []
+                        for i, gk in enumerate(group_keys):
+                            v = g[i]
+                            conds.append(
+                                ast.IsNull(gk) if v is None
+                                else ast.BinaryOp(
+                                    "=", gk, _value_to_literal(
+                                        v, key_dts[i])))
+                        cond = conds[0]
+                        for c in conds[1:]:
+                            cond = ast.BinaryOp("AND", cond, c)
+                        whens.append((cond,
+                                      ast.Literal(pctls[j][gi])))
+                    repl[call] = ast.FuncCall(
+                        "max", (ast.CaseWhen(tuple(whens), None),))
+                continue
+            # numeric/date keys: materialize a temp reference table and
+            # join it back on the group keys
+            temp_cols: dict[str, object] = {}
+            temp_names: list[str] = []
+            temp_dtypes: dict[str, object] = {}
+            for i in range(nk):
+                nmi = f"__pg{i}"
+                temp_names.append(nmi)
+                temp_cols[nmi] = np.asarray([g[i] for g in gtuples],
+                                            dtype=object)
+                temp_dtypes[nmi] = key_dts[i]
+            for j, (call, q) in enumerate(wants):
+                nmj = f"__pctl{len(extra_from)}_{j}"
+                temp_names.append(nmj)
+                temp_cols[nmj] = np.asarray(pctls[j], dtype=object)
+                temp_dtypes[nmj] = DataType.FLOAT64
+            from .executor.runner import ResultSet
+
+            shim = ResultSet(temp_names, temp_cols, len(gtuples),
+                             dtypes=temp_dtypes)
+            temp = self._store_result(shim, cleanup)
+            alias = f"__pctl_t{len(extra_from)}"
+            extra_from.append(ast.TableRef(temp, alias))
+            for i, g in enumerate(group_keys):
+                tcol = ast.ColumnRef(f"__pg{i}", table=alias)
+                eq = ast.BinaryOp("=", g, tcol)
+                if any(gt[i] is None for gt in gtuples):
+                    # NULL group keys group together (PG semantics) but
+                    # never compare equal — match them explicitly
+                    eq = ast.BinaryOp(
+                        "OR", eq,
+                        ast.BinaryOp("AND", ast.IsNull(g),
+                                     ast.IsNull(tcol)))
+                extra_where.append(eq)
+            for j, (call, _q) in enumerate(wants):
+                repl[call] = ast.FuncCall(
+                    "max",
+                    (ast.ColumnRef(f"__pctl{len(extra_from) - 1}_{j}",
+                                   table=alias),))
 
         def sub(e: ast.Expr) -> ast.Expr:
             if isinstance(e, ast.FuncCall) and e in repl:
                 return repl[e]
             return _map_children(e, sub)
 
-        return dc_replace(sel, items=tuple(
-            ast.SelectItem(sub(it.expr), it.alias) for it in sel.items))
-
-    def _column_range_for(self, ref: ast.ColumnRef, sel: ast.Select,
-                          cte_scope) -> tuple[float, float] | None:
-        """(min, max) of a plain column over sel's FROM tables, from
-        manifest statistics (exact for committed data)."""
-        for fi in sel.from_items:
-            if not isinstance(fi, ast.TableRef):
-                continue
-            if ref.table is not None and \
-                    (fi.alias or fi.name) != ref.table:
-                continue
-            name = cte_scope.get(fi.name, fi.name)
-            if not self.catalog.has_table(name):
-                continue
-            schema = self.catalog.table(name).schema
-            if not schema.has_column(ref.name):
-                continue
-            rng = self.store.column_range(name, ref.name)
-            if rng is None:
-                return None
-            return float(rng[0]), float(rng[1])
-        return None
+        where = sel.where
+        for c in extra_where:
+            where = c if where is None else ast.BinaryOp("AND", where, c)
+        return dc_replace(
+            sel,
+            items=tuple(ast.SelectItem(sub(it.expr), it.alias)
+                        for it in sel.items),
+            from_items=sel.from_items + tuple(extra_from),
+            where=where)
 
     def _subquery_select(self, q, cleanup, cte_scope) -> ast.Select:
         """Expression-subquery body → plain Select (compound bodies
@@ -1459,9 +1613,23 @@ def _concat_results(left, right, tag: bool):
         rdt = _result_dtype(right, rname)
         if ldt is not None and ldt == rdt:
             dtypes[lname] = ldt
-        elif DataType.DATE in (ldt, rdt):
-            raise PlanningError(
-                "set-operation columns mix DATE and non-DATE values")
+        elif ldt is not None and rdt is not None:
+            # PG: "UNION types X and Y cannot be matched".  Numeric
+            # widths widen (int/float mixes); everything else —
+            # DATE/non-DATE, STRING/numeric, BOOL/numeric — is an error
+            # rather than a silently mixed-type object column (r4
+            # advisor finding)
+            numeric = {DataType.INT32, DataType.INT64,
+                       DataType.FLOAT32, DataType.FLOAT64}
+            if not (ldt in numeric and rdt in numeric):
+                raise PlanningError(
+                    f"set-operation column {lname!r} mixes "
+                    f"{ldt.value} and {rdt.value} — types cannot be "
+                    "matched")
+            dtypes[lname] = (
+                DataType.FLOAT64
+                if DataType.FLOAT64 in (ldt, rdt)
+                or DataType.FLOAT32 in (ldt, rdt) else DataType.INT64)
     if tag:
         names.append("__side")
         cols["__side"] = np.concatenate(

@@ -464,6 +464,26 @@ class DropTable(Statement):
 
 
 @dataclass(frozen=True)
+class CreateView(Statement):
+    """CREATE [OR REPLACE] VIEW name [(cols)] AS select.
+
+    Views are propagated catalog objects in the reference
+    (commands/view.c:1-832); here the definition persists in the catalog
+    and references expand as derived tables at planning time."""
+
+    name: str
+    columns: tuple[str, ...]    # () = take names from the select list
+    sql: str                    # the view body's SQL text
+    or_replace: bool = False
+
+
+@dataclass(frozen=True)
+class DropView(Statement):
+    name: str
+    if_exists: bool = False
+
+
+@dataclass(frozen=True)
 class AlterTable(Statement):
     """ALTER TABLE … ADD/DROP/RENAME COLUMN (manifest-level schema
     evolution; reference: commands/alter_table.c)."""

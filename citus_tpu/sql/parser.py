@@ -705,8 +705,42 @@ class Parser:
                                   new_name=self.expect_ident())
         self.error("expected ADD, DROP, or RENAME after ALTER TABLE")
 
+    def _detokenize(self, start: int, end: int) -> str:
+        """Re-serialize a token span to SQL text (view bodies persist as
+        text in the catalog; there is no full deparser by design)."""
+        parts = []
+        for tok in self.tokens[start:end]:
+            if tok.kind == "string":
+                parts.append("'" + tok.value.replace("'", "''") + "'")
+            else:
+                parts.append(tok.value)
+        return " ".join(parts)
+
+    def parse_create_view(self) -> ast.Statement:
+        or_replace = False
+        if self.accept_word("or"):
+            self.expect_word("replace")
+            or_replace = True
+        self.expect_word("view")
+        name = self.expect_ident()
+        columns: list[str] = []
+        if self.accept_op("("):
+            columns.append(self.expect_ident())
+            while self.accept_op(","):
+                columns.append(self.expect_ident())
+            self.expect_op(")")
+        self.expect_keyword("as")
+        start = self.pos
+        self.parse_select()  # validate the body now; store it as text
+        return ast.CreateView(name, tuple(columns),
+                              self._detokenize(start, self.pos),
+                              or_replace)
+
     def parse_create_table(self) -> ast.Statement:
         self.expect_keyword("create")
+        if self.cur.value in ("view", "or") and \
+                self.cur.kind in ("ident", "keyword"):
+            return self.parse_create_view()
         if self.accept_word("sequence"):
             name = self.expect_ident()
             start, increment = 1, 1
@@ -754,7 +788,8 @@ class Parser:
     def parse_drop_table(self) -> ast.Statement:
         self.expect_keyword("drop")
         is_seq = self.accept_word("sequence")
-        if not is_seq:
+        is_view = False if is_seq else self.accept_word("view")
+        if not is_seq and not is_view:
             self.expect_keyword("table")
         if_exists = False
         if self.accept_keyword("if"):
@@ -763,6 +798,8 @@ class Parser:
         name = self.expect_ident()
         if is_seq:
             return ast.DropSequence(name, if_exists)
+        if is_view:
+            return ast.DropView(name, if_exists)
         return ast.DropTable(name, if_exists)
 
     def _expect_signed_integer(self) -> int:

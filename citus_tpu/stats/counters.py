@@ -20,6 +20,7 @@ QUERIES_SINGLE_SHARD = "queries_single_shard"
 QUERIES_MULTI_SHARD = "queries_multi_shard"
 QUERIES_REPARTITION = "queries_repartition"
 QUERIES_FAST_PATH = "queries_fast_path"
+POINT_INDEX_LOOKUPS = "point_index_lookups"
 SUBPLANS_EXECUTED = "subplans_executed"
 ROWS_INGESTED = "rows_ingested"
 ROWS_RETURNED = "rows_returned"
@@ -37,7 +38,7 @@ QUERIES_STREAMED = "queries_streamed"
 
 ALL_COUNTERS = [
     QUERIES_SINGLE_SHARD, QUERIES_MULTI_SHARD, QUERIES_REPARTITION,
-    QUERIES_FAST_PATH,
+    QUERIES_FAST_PATH, POINT_INDEX_LOOKUPS,
     SUBPLANS_EXECUTED, ROWS_INGESTED, ROWS_RETURNED,
     DML_UPDATE, DML_DELETE, DML_MERGE, DDL_COMMANDS,
     CAPACITY_RETRIES, DEVICE_ROWS_SCANNED,

@@ -1,0 +1,186 @@
+"""Persistent per-shard point-lookup index (key → stripe/row).
+
+The reference's columnar tables support btree/hash indexes for point
+lookups (/root/reference/src/backend/columnar/README.md:176).  The
+analogue here: a sorted-key sidecar per (shard, column) that the
+fast-path router consults for ``WHERE distcol = const`` — the lookup
+becomes one binary search + a read of ONLY the chunks holding the
+matching rows, instead of scanning the shard.
+
+Layout (``shard_dir/PKIDX_<col>.npz``, atomic-rename writes):
+  keys       sorted int64 key values
+  stripe_idx index into the signature's stripe list, per key
+  row_pos    physical row within that stripe, per key
+  sig        the manifest stripe list (file, rows) the index was built
+             from — any mismatch (DML appended/rewrote stripes) makes
+             the index stale and it rebuilds lazily on next use
+
+Deletion bitmaps don't invalidate the index: positions are physical,
+and the lookup re-applies the CURRENT delete mask.  Transaction-staged
+overlay data bypasses the index entirely (the caller falls back to the
+scan path).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from .format import StripeReader
+
+
+def _sig(records) -> list[tuple[str, int]]:
+    return [(r["file"], int(r["rows"])) for r in records]
+
+
+def _idx_path(store, table: str, shard_id: int, column: str) -> str:
+    return os.path.join(store.shard_dir(table, shard_id),
+                        f"PKIDX_{column}.npz")
+
+
+def _load(path: str):
+    try:
+        with np.load(path, allow_pickle=True) as z:
+            return (z["keys"], z["stripe_idx"], z["row_pos"],
+                    [tuple(x) for x in z["sig"]])
+    except Exception:
+        return None
+
+
+def _build(store, table: str, shard_id: int, column: str, records):
+    storage_col = store.storage_column_name(table, column)
+    keys_parts, sidx_parts, pos_parts = [], [], []
+    for i, rec in enumerate(records):
+        path = os.path.join(store.shard_dir(table, shard_id), rec["file"])
+        reader = StripeReader(path)
+        if storage_col not in reader._by_name:
+            continue  # pre-ALTER stripe: column reads as all-NULL
+        vals, mask, n = reader.read([storage_col])
+        v = np.asarray(vals[storage_col]).astype(np.int64)
+        m = np.asarray(mask[storage_col])  # validity: NULL keys excluded
+        pos = np.flatnonzero(m)
+        keys_parts.append(v[pos])
+        sidx_parts.append(np.full(pos.size, i, dtype=np.int32))
+        pos_parts.append(pos.astype(np.int64))
+    if keys_parts:
+        keys = np.concatenate(keys_parts)
+        sidx = np.concatenate(sidx_parts)
+        rpos = np.concatenate(pos_parts)
+        order = np.argsort(keys, kind="stable")
+        keys, sidx, rpos = keys[order], sidx[order], rpos[order]
+    else:
+        keys = np.zeros(0, np.int64)
+        sidx = np.zeros(0, np.int32)
+        rpos = np.zeros(0, np.int64)
+    return keys, sidx, rpos
+
+
+def lookup(store, table: str, shard_id: int, column: str,
+           value: int):
+    """Positions of rows where column == value, as
+    [(stripe_record, row_pos array)]; None when the index cannot be
+    used (overlay data present).  Builds/rebuilds the sidecar lazily."""
+    if store.overlay is not None and (
+            store._overlay_records(table, shard_id)
+            or any(t == table for (t, _s) in store.overlay.records)):
+        return None
+    records = store.manifest(table)["shards"].get(str(shard_id), [])
+    sig = _sig(records)
+    path = _idx_path(store, table, shard_id, column)
+    loaded = _load(path)
+    if loaded is not None and loaded[3] == sig:
+        keys, sidx, rpos = loaded[:3]
+    else:
+        keys, sidx, rpos = _build(store, table, shard_id, column, records)
+        try:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            tmp = path + ".tmp.npz"
+            np.savez(tmp, keys=keys, stripe_idx=sidx, row_pos=rpos,
+                     sig=np.asarray(sig, dtype=object))
+            os.replace(tmp, path)
+        except OSError:
+            pass  # persistence is best-effort; in-memory result is valid
+    lo = int(np.searchsorted(keys, value, side="left"))
+    hi = int(np.searchsorted(keys, value, side="right"))
+    out = []
+    for i in range(lo, hi):
+        out.append((records[int(sidx[i])], int(rpos[i])))
+    return out
+
+
+def read_rows(store, table: str, shard_id: int, columns: list[str],
+              hits) -> tuple[dict, dict, int]:
+    """Materialize the hit rows (values, validity, n), reading only the
+    chunks that contain them and honoring current deletion bitmaps."""
+    meta = store.catalog.table(table)
+    storage_of = {c: store.storage_column_name(table, c) for c in columns}
+    by_stripe: dict[str, list[int]] = {}
+    rec_of: dict[str, dict] = {}
+    for rec, pos in hits:
+        by_stripe.setdefault(rec["file"], []).append(pos)
+        rec_of[rec["file"]] = rec
+    vals_out = {c: [] for c in columns}
+    mask_out = {c: [] for c in columns}
+    n = 0
+    for fname, positions in by_stripe.items():
+        rec = rec_of[fname]
+        dmask = store.effective_delete_mask(table, shard_id, rec)
+        live = [p for p in positions
+                if dmask is None or not bool(dmask[p])]
+        if not live:
+            continue
+        path = os.path.join(store.shard_dir(table, shard_id), fname)
+        reader = StripeReader(path)
+        # chunk index per live position; read ONLY those chunks
+        bounds = np.cumsum(np.asarray(reader.footer["chunk_rows"]))
+        pos_arr = np.asarray(live, dtype=np.int64)
+        chunk_of = np.searchsorted(bounds, pos_arr, side="right")
+        wanted = set(int(c) for c in chunk_of)
+        starts = np.concatenate([[0], bounds[:-1]])
+        sel = sorted(wanted)
+        # map stripe position → position within the concatenated read
+        offset_of = {}
+        acc = 0
+        for ci in sel:
+            offset_of[ci] = acc - int(starts[ci])
+            acc += int(bounds[ci] - starts[ci])
+        present = [storage_of[c] for c in columns
+                   if storage_of[c] in reader._by_name]
+        fil = _IndexChunkFilter(sel)
+        v, m, _cnt = reader.read(present, fil)
+        local = pos_arr + np.asarray(
+            [offset_of[int(c)] for c in chunk_of], dtype=np.int64)
+        for c in columns:
+            s = storage_of[c]
+            if s in v:
+                vals_out[c].append(np.asarray(v[s])[local])
+                mask_out[c].append(np.asarray(m[s])[local])
+            else:  # post-ALTER column: NULL for old stripes
+                dt = meta.schema.column(c).dtype.numpy_dtype
+                vals_out[c].append(np.zeros(local.size, dtype=dt))
+                mask_out[c].append(np.zeros(local.size, dtype=bool))
+        n += local.size
+    out_v, out_m = {}, {}
+    for c in columns:
+        if vals_out[c]:
+            out_v[c] = np.concatenate(vals_out[c])
+            out_m[c] = np.concatenate(mask_out[c])
+        else:
+            dt = meta.schema.column(c).dtype.numpy_dtype
+            out_v[c] = np.zeros(0, dtype=dt)
+            out_m[c] = np.zeros(0, dtype=bool)
+    return out_v, out_m, n
+
+
+class _IndexChunkFilter:
+    """chunk_filter selecting chunks by INDEX (stateful counter — the
+    reader calls it once per chunk in order)."""
+
+    def __init__(self, wanted: list[int]):
+        self.wanted = set(wanted)
+        self._i = -1
+
+    def __call__(self, _stats) -> bool:
+        self._i += 1
+        return self._i in self.wanted

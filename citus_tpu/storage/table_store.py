@@ -75,6 +75,9 @@ class TableStore:
         # executor's device-feed cache keys on it (the metadata-cache
         # invalidation analogue, metadata/metadata_cache.c:287)
         self._data_versions: dict[str, int] = {}
+        # table → (mtime_ns, size) of the manifest file the cached
+        # manifest was loaded from (cross-session staleness detection)
+        self._manifest_stats: dict[str, tuple] = {}
         # read-your-writes overlay, set by an open transaction
         # (transaction.manager.Transaction): staged-but-uncommitted stripe
         # records and deletion masks folded into every read
@@ -105,13 +108,46 @@ class TableStore:
                 if os.path.exists(path):
                     with open(path) as f:
                         self._manifests[table] = json.load(f)
+                    self._record_manifest_stat(table)
                 else:
                     self._manifests[table] = {"next_stripe": 1, "shards": {}}
+                    self._manifest_stats.pop(table, None)
             return self._manifests[table]
 
     def _save_manifest(self, table: str) -> None:
         os.makedirs(self.table_dir(table), exist_ok=True)
         atomic_write_json(self._manifest_path(table), self._manifests[table])
+        with self._lock:
+            self._record_manifest_stat(table)
+
+    def _record_manifest_stat(self, table: str) -> None:
+        """Remember the on-disk manifest's identity (caller holds lock)."""
+        try:
+            st = os.stat(self._manifest_path(table))
+            self._manifest_stats[table] = (st.st_mtime_ns, st.st_size)
+        except OSError:
+            self._manifest_stats.pop(table, None)
+
+    def refresh_if_stale(self, table: str) -> bool:
+        """Reload the cached manifest iff ANOTHER session committed a
+        newer one to disk (one stat() per check).  The read-path
+        counterpart of `refresh`: writers refresh under the DML lock,
+        readers call this before building feeds so cross-session
+        read-committed visibility holds without invalidating warm feed
+        caches on every query.  Returns True when a reload happened."""
+        with self._lock:
+            if table not in self._manifests:
+                return False  # next read loads from disk anyway
+            try:
+                st = os.stat(self._manifest_path(table))
+                disk = (st.st_mtime_ns, st.st_size)
+            except OSError:
+                disk = None
+            if self._manifest_stats.get(table) == disk:
+                return False
+            self._manifests.pop(table, None)
+            self.bump_data_version(table)
+            return True
 
     def _write_lock(self, table: str) -> threading.Lock:
         key = (os.path.abspath(self.data_dir), table)

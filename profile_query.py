@@ -1,0 +1,142 @@
+"""Per-stage plan profiler: capacities vs estimates vs actuals.
+
+Usage:
+    python profile_query.py --sf 1 Q3            # named TPC-H query
+    python profile_query.py --sf 10 "select ..." # ad-hoc SQL
+
+Loads (or reuses) a persistent TPC-H data dir under .benchdata/sf{N},
+plans the query, and prints one line per plan node: node kind, join
+strategy, planner estimates (est_rows / est_expansion / est_groups),
+and the static buffer capacities `Executor._initial_capacities` assigns
+(scan_out / repartition / join_out / agg_out).  Then executes the query
+(warm best-of-N) and reports timing + result size, so capacity
+inflation (capacity >> actual rows) is visible stage by stage.
+
+This is the measurement half of the round-5 capacity work: the
+reference's adaptive executor never over-allocates because tasks stream
+actual result sizes (adaptive_executor.c:962); here buffers are static,
+so the planner's estimates must be close — this tool shows where they
+are not.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+
+
+def open_session(sf: float, tables=("customer", "orders", "lineitem",
+                                    "supplier", "part", "partsupp",
+                                    "nation", "region")):
+    from citus_tpu.session import Session
+    from citus_tpu.ingest.tpch import load_into_session
+
+    tag = ("sf%g" % sf).replace(".", "_")
+    data_dir = os.path.join(REPO, ".benchdata", tag)
+    loaded = os.path.exists(os.path.join(data_dir, "catalog.json"))
+    sess = Session(data_dir=data_dir)
+    if not loaded or sess.store.table_row_count("lineitem") == 0:
+        print(f"# loading TPC-H sf={sf} into {data_dir} ...",
+              file=sys.stderr)
+        t0 = time.perf_counter()
+        load_into_session(sess, sf=sf, seed=0, tables=set(tables))
+        print(f"# loaded in {time.perf_counter() - t0:.1f}s",
+              file=sys.stderr)
+    return sess
+
+
+def describe_plan(sess, plan):
+    """Print one row per node: estimates + the capacities the executor
+    would assign for the current feeds."""
+    from citus_tpu.executor.feed import build_feeds, walk_plan
+    from citus_tpu.planner.plan import (AggregateNode, JoinNode, ScanNode,
+                                        WindowNode)
+    import numpy as np
+
+    compute_dtype = np.dtype(sess.settings.get("compute_dtype"))
+    feeds = build_feeds(plan, sess.catalog, sess.store, sess.mesh,
+                        compute_dtype, cache=sess.executor.feed_cache)
+    caps = sess.executor._initial_capacities(plan, feeds)
+    n_dev = plan.n_devices
+    print(f"# n_devices={n_dev}")
+    hdr = (f"{'node':<28} {'strategy':<18} {'est_rows':>12} "
+           f"{'feed_cap':>12} {'scan_out':>10} {'repart':>12} "
+           f"{'join_out':>12} {'agg_out':>10}")
+    print(hdr)
+    print("-" * len(hdr))
+    for node in walk_plan(plan.root):
+        nid = id(node)
+        kind = type(node).__name__.replace("Node", "")
+        strat = ""
+        est = getattr(node, "est_rows", "")
+        feed_cap = ""
+        if isinstance(node, ScanNode):
+            kind = f"Scan({node.rel.table})"
+            feed_cap = feeds[nid].capacity * (
+                n_dev if feeds[nid].sharded else 1)
+        elif isinstance(node, JoinNode):
+            strat = node.strategy
+            if getattr(node, "fuse_lookup", False):
+                strat += "+fuse"
+            strat += f"/{node.join_type}"
+            est = (f"{node.est_rows} (x{node.est_expansion:.2f})"
+                   if node.est_expansion else node.est_rows)
+        elif isinstance(node, AggregateNode):
+            strat = node.combine
+            est = f"g={node.est_groups}"
+            if node.dense_keys is not None:
+                strat += f"/dense{node.dense_total}"
+        elif isinstance(node, WindowNode):
+            strat = node.combine
+        rp = caps.repartition.get(nid, "")
+        rp_total = f"{rp}x{n_dev}" if rp != "" else ""
+        print(f"{kind:<28} {strat:<18} {str(est):>12} "
+              f"{str(feed_cap):>12} {str(caps.scan_out.get(nid, '')):>10} "
+              f"{rp_total:>12} {str(caps.join_out.get(nid, '')):>12} "
+              f"{str(caps.agg_out.get(nid, '')):>10}")
+    return caps
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("query", help="TPC-H query name (Q3) or SQL text")
+    ap.add_argument("--sf", type=float, default=1.0)
+    ap.add_argument("--repeats", type=int, default=3)
+    ap.add_argument("--no-exec", action="store_true")
+    ap.add_argument("--counts", action="store_true",
+                    help="also run count(*) probes for common Q3 stages")
+    args = ap.parse_args()
+
+    from citus_tpu.ingest.tpch import QUERIES
+    from citus_tpu.sql.parser import parse_one
+
+    sql = QUERIES.get(args.query.upper(), args.query)
+    sess = open_session(args.sf)
+    stmt = parse_one(sql)
+    plan, cleanup = sess._plan_select(stmt)
+    try:
+        describe_plan(sess, plan)
+        if args.no_exec:
+            return
+        t0 = time.perf_counter()
+        r = sess.execute(sql)
+        cold = time.perf_counter() - t0
+        best = float("inf")
+        for _ in range(args.repeats):
+            t0 = time.perf_counter()
+            r = sess.execute(sql)
+            best = min(best, time.perf_counter() - t0)
+        print(f"\ncold {cold:.3f}s   warm best-of-{args.repeats} "
+              f"{best:.3f}s   rows={r.row_count}   retries={r.retries}  "
+              f"device_rows_scanned={r.device_rows_scanned}")
+    finally:
+        for t in cleanup:
+            sess._drop_temp(t)
+
+
+if __name__ == "__main__":
+    main()

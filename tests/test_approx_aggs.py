@@ -75,6 +75,22 @@ class TestApproxCountDistinct:
         exact = len(np.unique(d["u"][d["w"] < 10]))
         assert abs(got - exact) <= 0.06 * exact, (got, exact)
 
+    def test_empty_input_is_zero(self, sess):
+        # r4 advisor: the level-2 sum(2^-rho) over zero rows is NULL and
+        # used to propagate through the estimate arithmetic; coalesced
+        # registers make the linear-counting branch return exactly 0,
+        # matching exact count(distinct) on empty input
+        s, _ = sess
+        got = s.execute("select approx_count_distinct(u) from ev "
+                        "where w < 0").rows()[0][0]
+        assert got == 0, got
+
+
+def _rel_close(got, exact, alpha=0.015, abs_floor=1e-6):
+    """DDSketch contract: |x̂ - x_q| ≤ α·|x_q| (α ≈ 1%; slack for the
+    device's float32 log at bucket boundaries)."""
+    return abs(got - exact) <= max(alpha * abs(exact), abs_floor)
+
 
 class TestApproxPercentile:
     def test_median(self, sess):
@@ -82,14 +98,14 @@ class TestApproxPercentile:
         got = s.execute("select approx_percentile(x, 0.5) from ev"
                         ).rows()[0][0]
         exact = float(np.quantile(d["x"], 0.5))
-        assert abs(got - exact) <= 0.01 * 1000.0, (got, exact)
+        assert _rel_close(got, exact), (got, exact)
 
     def test_tail_quantile_with_filter(self, sess):
         s, d = sess
         got = s.execute("select approx_percentile(x, 0.95) from ev "
                         "where g = 1").rows()[0][0]
         exact = float(np.quantile(d["x"][d["g"] == 1], 0.95))
-        assert abs(got - exact) <= 0.01 * 1000.0, (got, exact)
+        assert _rel_close(got, exact), (got, exact)
 
     def test_alongside_other_aggs(self, sess):
         s, d = sess
@@ -98,13 +114,131 @@ class TestApproxPercentile:
         assert r[0] == len(d["k"])
         assert abs(r[1] - float(np.quantile(d["w"], 0.5))) <= 2.0
 
-    def test_grouped_percentile_unsupported(self, sess):
-        s, _ = sess
-        from citus_tpu.errors import UnsupportedQueryError
+    def test_grouped(self, sess):
+        # r4 VERDICT missing #4: grouped percentiles via the mergeable
+        # DDSketch (reference: worker tdigest + coordinator merge,
+        # multi_logical_optimizer.c:2046)
+        s, d = sess
+        r = s.execute("select g, approx_percentile(x, 0.5) from ev "
+                      "group by g order by g")
+        assert r.row_count == 4
+        for g, got in r.rows():
+            exact = float(np.quantile(d["x"][d["g"] == g], 0.5))
+            assert _rel_close(got, exact), (g, got, exact)
 
-        with pytest.raises(UnsupportedQueryError):
-            s.execute("select g, approx_percentile(x, 0.5) from ev "
-                      "group by g")
+    def test_grouped_with_other_aggs_and_quantiles(self, sess):
+        s, d = sess
+        r = s.execute(
+            "select g, count(*), approx_percentile(x, 0.25), "
+            "approx_percentile(x, 0.9), sum(w) from ev "
+            "group by g order by g")
+        for g, cnt, q25, q90, sw in r.rows():
+            m = d["g"] == g
+            assert cnt == int(m.sum())
+            assert sw == int(d["w"][m].sum())
+            assert _rel_close(q25, float(np.quantile(d["x"][m], 0.25)))
+            assert _rel_close(q90, float(np.quantile(d["x"][m], 0.9)))
+
+    def test_heavy_tail_outlier_robust(self, tmp_path):
+        # the old min/max histogram failure mode: ONE huge outlier
+        # stretched every bucket.  DDSketch's relative-error bound is
+        # range-independent — the median stays accurate.
+        s = citus_tpu.connect(data_dir=str(tmp_path / "ht"), n_devices=4,
+                              compute_dtype="float64")
+        s.execute("create table ht (k bigint, g bigint, "
+                  "v double precision)")
+        s.create_distributed_table("ht", "k", shard_count=4)
+        rng = np.random.default_rng(3)
+        n = 4000
+        # lognormal body + catastrophic outliers
+        v = rng.lognormal(3.0, 2.0, n)
+        v[::1000] = 1e15
+        rows = ",".join(f"({i}, {i % 3}, {float(x):.6f})"
+                for i, x in enumerate(v))
+        s.execute(f"insert into ht values {rows}")
+        got = s.execute(
+            "select approx_percentile(v, 0.5) from ht").rows()[0][0]
+        exact = float(np.quantile(v, 0.5))
+        assert _rel_close(got, exact), (got, exact)
+        r = s.execute("select g, approx_percentile(v, 0.99) from ht "
+                      "group by g order by g")
+        for g, got in r.rows():
+            exact = float(np.quantile(v[np.arange(n) % 3 == g], 0.99))
+            # 0.99 on 1.3k points: nearest-rank wobble adds a little
+            assert abs(got - exact) <= 0.03 * abs(exact), (g, got, exact)
+        s.close()
+
+    def test_negative_and_zero_values(self, tmp_path):
+        s = citus_tpu.connect(data_dir=str(tmp_path / "nz"), n_devices=2,
+                              compute_dtype="float64")
+        s.execute("create table nz (k bigint, v double precision)")
+        s.create_distributed_table("nz", "k", shard_count=2)
+        vals = [-1000.0, -10.0, -0.5, 0.0, 0.5, 10.0, 1000.0]
+        rows = ",".join(f"({i}, {float(x):.6f})"
+                for i, x in enumerate(vals))
+        s.execute(f"insert into nz values {rows}")
+        got = s.execute(
+            "select approx_percentile(v, 0.5) from nz").rows()[0][0]
+        assert abs(got - 0.0) <= 1e-6, got
+        lo = s.execute(
+            "select approx_percentile(v, 0.0) from nz").rows()[0][0]
+        assert _rel_close(lo, -1000.0), lo
+        s.close()
+
+    def test_all_null_group_still_appears(self, tmp_path):
+        # review finding r5: a group whose sketched column is ALL NULL
+        # must still produce an output row (NULL percentile, PG
+        # semantics) — the temp-table join used to drop it entirely
+        s = citus_tpu.connect(data_dir=str(tmp_path / "an"), n_devices=2,
+                              compute_dtype="float64")
+        s.execute("create table an (k bigint, g bigint, "
+                  "v double precision)")
+        s.create_distributed_table("an", "k", shard_count=2)
+        s.execute("insert into an values (1, 1, 5.0), (2, 1, 7.0), "
+                  "(3, 2, null), (4, 2, null)")
+        r = s.execute("select g, count(*), approx_percentile(v, 0.5) "
+                      "from an group by g order by g")
+        rows = {g: (c, p) for g, c, p in r.rows()}
+        assert rows[1][0] == 2 and _rel_close(rows[1][1], 5.0, 0.02)
+        assert rows[2] == (2, None)
+        s.close()
+
+    def test_grouped_string_key(self, tmp_path):
+        # string group keys can't join the temp table (no cross-table
+        # dictionary alignment) — they inline as a CASE over observed
+        # group values (found by the round-5 verify drive)
+        s = citus_tpu.connect(data_dir=str(tmp_path / "sg"), n_devices=2,
+                              compute_dtype="float64")
+        s.execute("create table sg (k bigint, seg text, "
+                  "v double precision)")
+        s.create_distributed_table("sg", "k", shard_count=2)
+        rows = ",".join(f"({i}, '{'ABC'[i % 3]}', {float(i)})"
+                        for i in range(300))
+        s.execute(f"insert into sg values {rows}")
+        r = s.execute("select seg, approx_percentile(v, 0.5), count(*) "
+                      "from sg group by seg order by seg")
+        assert r.row_count == 3
+        for seg, med, cnt in r.rows():
+            exact = float(np.median(
+                [float(i) for i in range(300) if "ABC"[i % 3] == seg]))
+            assert cnt == 100
+            assert _rel_close(med, exact, alpha=0.02), (seg, med, exact)
+        s.close()
+
+    def test_grouped_null_group_key(self, tmp_path):
+        s = citus_tpu.connect(data_dir=str(tmp_path / "ng"), n_devices=2,
+                              compute_dtype="float64")
+        s.execute("create table ng (k bigint, g bigint, "
+                  "v double precision)")
+        s.create_distributed_table("ng", "k", shard_count=2)
+        s.execute("insert into ng values (1, 1, 10.0), (2, 1, 20.0), "
+                  "(3, null, 7.0), (4, null, 9.0)")
+        r = s.execute("select g, approx_percentile(v, 1.0) from ng "
+                      "group by g order by g")
+        vals = {g: v for g, v in r.rows()}
+        assert _rel_close(vals[1], 20.0)
+        assert _rel_close(vals[None], 9.0)
+        s.close()
 
 
 class TestMultipleDistinct:

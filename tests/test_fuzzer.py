@@ -27,9 +27,15 @@ DATE_COLUMNS = {
 
 @pytest.fixture(scope="module")
 def fuzz_env(tmp_path_factory):
+    # feedback recompiles each unique query once (tightened buffers) —
+    # doubling this module's XLA compile count would cross the
+    # per-process jaxlib crash threshold pytest.ini documents.  The
+    # feedback path has its own coverage (test_prepared, isolation);
+    # fuzzing targets planner/executor SEMANTICS, so run it off here.
     sess = citus_tpu.connect(
         data_dir=str(tmp_path_factory.mktemp("fuzz_tpch")),
-        n_devices=4, compute_dtype="float64")
+        n_devices=4, compute_dtype="float64",
+        enable_capacity_feedback=False)
     tpch.load_into_session(sess, sf=0.002, seed=23, shard_count=8)
     conn = make_oracle(tpch.generate_tables(0.002, seed=23), DATE_COLUMNS)
     return sess, conn

@@ -45,6 +45,21 @@ class TestHealthCheck:
         # sweep is idempotent: already-inactive nodes stay untouched
         assert health.health_sweep(sess) == []
 
+    def test_storage_probe_reads_disk(self, sess):
+        # r4 advisor: the storage leg must be a REAL disk read, so a
+        # spare node (no device, no shards) over unreachable storage
+        # probes unhealthy instead of always-true
+        sess.execute("SELECT citus_add_node('spare:1')")
+        names = {n: h for n, _a, h in health.check_cluster_health(sess)}
+        assert names["spare:1"] is True
+        real_dir = sess.store.data_dir
+        try:
+            sess.store.data_dir = real_dir + ".gone"
+            names = {n: h for n, _a, h in health.check_cluster_health(sess)}
+            assert names["spare:1"] is False
+        finally:
+            sess.store.data_dir = real_dir
+
     def test_daemon_runs_sweeps(self, tmp_data_dir):
         s = citus_tpu.connect(data_dir=tmp_data_dir, n_devices=1,
                               health_check_interval_ms=50)

@@ -1,0 +1,634 @@
+"""Pairwise isolation matrix: concurrent-OPERATION semantics.
+
+The reference pins these behaviors with 124 isolation specs under
+/root/reference/src/test/regress/spec/ (permutations of steps across
+concurrent sessions, e.g. isolation_concurrent_dml.spec's
+"in-progress insert blocks concurrent updates").  The fault-injection
+matrix (test_fault_injection.py) covers crash seams; this file covers
+the other axis: two live operations interleaving.  Every scenario
+asserts a semantic invariant — visibility, atomicity, ordering, or
+conservation — not just "no exception".
+
+Scenario families:
+  A. two-writer 2PL conflicts        (blocking, serialization)
+  B. deadlock cycles                 (youngest-victim, retry)
+  C. shard split × DML / reads       (conservation, routing)
+  D. shard move / rebalance × reads  (consistency, failover)
+  E. CDC × concurrent DML            (ordering, replay equivalence)
+  F. restore point × concurrent txn  (atomicity of the cut)
+  G. health sweep × queries          (no false positives, stability)
+  H. background jobs × DDL           (cleanup, idempotence)
+  I. 2PC recovery × concurrent reads (roll-forward visibility)
+"""
+
+import threading
+import time
+
+import pytest
+
+import citus_tpu
+from citus_tpu.session import Session
+from citus_tpu.transaction.locks import DeadlockDetectedError
+
+
+def make_session(data_dir, **kw):
+    return Session(data_dir=str(data_dir), **kw)
+
+
+def setup(sess, name="t", rows=24, shards=4):
+    sess.execute(f"CREATE TABLE {name} (id INT, v INT)")
+    sess.execute(f"SELECT create_distributed_table('{name}', 'id', "
+                 f"{shards})")
+    if rows:
+        vals = ", ".join(f"({i}, {i * 10})" for i in range(rows))
+        sess.execute(f"INSERT INTO {name} VALUES {vals}")
+    return rows, sum(i * 10 for i in range(rows))
+
+
+def totals(sess, name="t"):
+    row = sess.execute(f"SELECT count(*), sum(v) FROM {name}").rows()[0]
+    return int(row[0]), int(row[1])
+
+
+def run_thread(fn):
+    out = {}
+
+    def wrap():
+        try:
+            out["result"] = fn()
+        except Exception as e:  # surfaced by join_thread
+            out["error"] = e
+
+    t = threading.Thread(target=wrap)
+    t.start()
+    return t, out
+
+
+def join_thread(t, out, timeout=60):
+    t.join(timeout=timeout)
+    assert not t.is_alive(), "isolation step hung"
+    if "error" in out:
+        raise out["error"]
+    return out.get("result")
+
+
+# -- A. two-writer 2PL conflicts ----------------------------------------
+class TestTwoWriters:
+    def test_txn_write_blocks_second_writer(self, tmp_path):
+        # isolation_concurrent_dml.spec permutation 1: an in-progress
+        # write blocks a concurrent update to the same rows until COMMIT
+        s1 = make_session(tmp_path)
+        setup(s1)
+        s2 = make_session(tmp_path)
+        s1.execute("BEGIN")
+        s1.execute("UPDATE t SET v = 1 WHERE id = 3")
+        started = threading.Event()
+
+        def blocked():
+            started.set()
+            s2.execute("UPDATE t SET v = 2 WHERE id = 3")
+
+        t, out = run_thread(blocked)
+        started.wait(10)
+        time.sleep(0.3)
+        assert t.is_alive(), "second writer should block on the 2PL lock"
+        s1.execute("COMMIT")
+        join_thread(t, out)
+        # s2 applied AFTER s1: last-committed value wins
+        assert int(s1.execute(
+            "SELECT v FROM t WHERE id = 3").rows()[0][0]) == 2
+
+    def test_serialized_increments_conserve_both(self, tmp_path):
+        # two read-modify-write transactions on one row serialize: both
+        # increments survive (lost-update prevention via 2PL)
+        s1 = make_session(tmp_path)
+        setup(s1)
+        s2 = make_session(tmp_path)
+
+        def inc(s):
+            def go():
+                s.execute("BEGIN")
+                s.execute("UPDATE t SET v = v + 1 WHERE id = 5")
+                time.sleep(0.1)
+                s.execute("COMMIT")
+            return go
+
+        t1, o1 = run_thread(inc(s1))
+        t2, o2 = run_thread(inc(s2))
+        join_thread(t1, o1)
+        join_thread(t2, o2)
+        assert int(s1.execute(
+            "SELECT v FROM t WHERE id = 5").rows()[0][0]) == 52
+
+    def test_disjoint_shard_writers_do_not_block(self, tmp_path):
+        # writes to different shards must proceed concurrently (the
+        # reference's per-shard lock granularity, not a table lock)
+        s1 = make_session(tmp_path)
+        setup(s1)
+        s2 = make_session(tmp_path)
+        ids = [s.shard_id for s in s1.catalog.table_shards("t")]
+        assert len(ids) >= 2
+        # find two ids routed to different shards
+        import numpy as np
+
+        from citus_tpu.catalog.distribution import hash_token
+        by_shard = {}
+        for i in range(24):
+            tok = int(hash_token(np.asarray([i], dtype=np.int64))[0])
+            for sh in s1.catalog.table_shards("t"):
+                if sh.contains_token(tok):
+                    by_shard.setdefault(sh.shard_id, i)
+        a, b = list(by_shard.values())[:2]
+        s1.execute("BEGIN")
+        s1.execute(f"UPDATE t SET v = 1 WHERE id = {a}")
+        done = threading.Event()
+
+        def other():
+            s2.execute(f"UPDATE t SET v = 2 WHERE id = {b}")
+            done.set()
+
+        t, out = run_thread(other)
+        assert done.wait(20), \
+            "disjoint-shard writer must not wait on s1's lock"
+        join_thread(t, out)
+        s1.execute("COMMIT")
+
+    def test_insert_vs_update_conservation(self, tmp_path):
+        # concurrent INSERT txn + UPDATE autocommit: whatever the
+        # interleaving, committed state shows both effects exactly once
+        s1 = make_session(tmp_path)
+        n, sm = setup(s1)
+        s2 = make_session(tmp_path)
+        s1.execute("BEGIN")
+        s1.execute("INSERT INTO t VALUES (100, 1000)")
+
+        def upd():
+            s2.execute("UPDATE t SET v = v + 5 WHERE id = 1")
+
+        t, out = run_thread(upd)
+        time.sleep(0.2)
+        s1.execute("COMMIT")
+        join_thread(t, out)
+        assert totals(s1) == (n + 1, sm + 1000 + 5)
+
+
+# -- B. deadlock cycles --------------------------------------------------
+class TestDeadlocks:
+    def test_three_session_cycle_one_victim(self, tmp_path):
+        # a 3-cycle in the wait graph: exactly one youngest victim is
+        # cancelled, the other two commit (lock_graph.c:142 +
+        # distributed_deadlock_detection.c youngest-victim rule)
+        s = [make_session(tmp_path) for _ in range(3)]
+        for i in range(3):
+            setup(s[0] if i == 0 else s[i], name=f"d{i}", rows=2)
+        barrier = threading.Barrier(3, timeout=30)
+        outcome = {}
+
+        def worker(i):
+            def go():
+                si = s[i]
+                si.execute("BEGIN")
+                si.execute(f"UPDATE d{i} SET v = {i}")
+                barrier.wait()
+                try:
+                    si.execute(f"UPDATE d{(i + 1) % 3} SET v = {i}")
+                    si.execute("COMMIT")
+                    outcome[i] = "ok"
+                except DeadlockDetectedError:
+                    outcome[i] = "victim"
+            return go
+
+        threads = [run_thread(worker(i)) for i in range(3)]
+        for t, out in threads:
+            join_thread(t, out, timeout=90)
+        assert sorted(outcome.values()) == ["ok", "ok", "victim"], outcome
+
+    def test_victim_retry_commits(self, tmp_path):
+        # after cancellation the victim's retry must succeed and both
+        # transactions' effects land (the reference expects clients to
+        # retry serialization failures)
+        s1 = make_session(tmp_path)
+        setup(s1, name="a", rows=2)
+        setup(s1, name="b", rows=2)
+        s2 = make_session(tmp_path)
+        barrier = threading.Barrier(2, timeout=30)
+
+        def w(s, first, second, tag, outcome):
+            def go():
+                for attempt in range(6):
+                    s.execute("BEGIN")
+                    try:
+                        s.execute(f"UPDATE {first} SET v = v + 1")
+                        if attempt == 0:
+                            barrier.wait()
+                        s.execute(f"UPDATE {second} SET v = v + 1")
+                        s.execute("COMMIT")
+                        outcome[tag] = "ok"
+                        return
+                    except DeadlockDetectedError:
+                        outcome[tag] = "retrying"
+                        # rolled back automatically; back off like a
+                        # real client (an instant retry can re-enter
+                        # the same cycle and lose again)
+                        time.sleep(0.05 * (attempt + 1))
+            return go
+
+        outcome = {}
+        t1, o1 = run_thread(w(s1, "a", "b", "s1", outcome))
+        t2, o2 = run_thread(w(s2, "b", "a", "s2", outcome))
+        join_thread(t1, o1, 90)
+        join_thread(t2, o2, 90)
+        assert outcome == {"s1": "ok", "s2": "ok"}
+        # both increments applied to both tables
+        assert int(s1.execute(
+            "SELECT sum(v) FROM a").rows()[0][0]) == 10 + 2 * 2
+        assert int(s1.execute(
+            "SELECT sum(v) FROM b").rows()[0][0]) == 10 + 2 * 2
+
+
+# -- C. shard split × DML / reads ---------------------------------------
+class TestSplitInterleavings:
+    def test_split_with_concurrent_inserts_conserves_rows(self, tmp_path):
+        # isolation_blocking_shard_split.spec: rows inserted while a
+        # split runs are present exactly once afterwards
+        s1 = make_session(tmp_path)
+        setup(s1, rows=40)
+        s2 = make_session(tmp_path)
+        stop = threading.Event()
+        inserted = []
+
+        def inserter():
+            k = 1000
+            while not stop.is_set():
+                s2.execute(f"INSERT INTO t VALUES ({k}, {k})")
+                inserted.append(k)
+                k += 1
+            return inserted
+
+        t, out = run_thread(inserter)
+        time.sleep(0.1)
+        for shard in list(s1.catalog.table_shards("t"))[:2]:
+            mid = (shard.min_value + shard.max_value) // 2
+            s1.execute("SELECT citus_split_shard_by_split_points("
+                       f"{shard.shard_id}, '{mid}')")
+        time.sleep(0.2)
+        stop.set()
+        join_thread(t, out)
+        n, sm = totals(s1)
+        assert n == 40 + len(inserted)
+        assert sm == sum(i * 10 for i in range(40)) + sum(inserted)
+        # every inserted row routes correctly post-split
+        for k in inserted[:3] + inserted[-3:]:
+            assert int(s1.execute(
+                f"SELECT v FROM t WHERE id = {k}").rows()[0][0]) == k
+
+    def test_split_waits_for_inflight_txn(self, tmp_path):
+        # a split of a shard with an uncommitted write must not lose the
+        # write: it either blocks until COMMIT or sees the committed row
+        s1 = make_session(tmp_path)
+        setup(s1, rows=16)
+        s2 = make_session(tmp_path)
+        s1.execute("BEGIN")
+        s1.execute("UPDATE t SET v = 7777 WHERE id = 2")
+        import numpy as np
+
+        from citus_tpu.catalog.distribution import hash_token
+        tok = int(hash_token(np.asarray([2], dtype=np.int64))[0])
+        shard = next(sh for sh in s1.catalog.table_shards("t")
+                     if sh.contains_token(tok))
+        mid = (shard.min_value + shard.max_value) // 2
+
+        def splitter():
+            s2.execute("SELECT citus_split_shard_by_split_points("
+                       f"{shard.shard_id}, '{mid}')")
+
+        t, out = run_thread(splitter)
+        time.sleep(0.3)
+        s1.execute("COMMIT")
+        try:
+            join_thread(t, out, 60)
+        except Exception:
+            pass  # a clean refusal is acceptable; losing the write is not
+        assert int(s1.execute(
+            "SELECT v FROM t WHERE id = 2").rows()[0][0]) == 7777
+
+    def test_reads_stable_during_split(self, tmp_path):
+        s1 = make_session(tmp_path)
+        n, sm = setup(s1, rows=60)
+        s2 = make_session(tmp_path)
+        stop = threading.Event()
+
+        def reader():
+            checks = 0
+            while not stop.is_set():
+                assert totals(s2) == (n, sm)
+                checks += 1
+            return checks
+
+        t, out = run_thread(reader)
+        for shard in list(s1.catalog.table_shards("t"))[:3]:
+            mid = (shard.min_value + shard.max_value) // 2
+            s1.execute("SELECT citus_split_shard_by_split_points("
+                       f"{shard.shard_id}, '{mid}')")
+        stop.set()
+        checks = join_thread(t, out)
+        assert checks > 0
+        assert totals(s1) == (n, sm)
+
+
+# -- D. shard move / rebalance × reads ----------------------------------
+class TestMoveInterleavings:
+    def test_reads_consistent_during_move(self, tmp_path):
+        s1 = make_session(tmp_path)
+        n, sm = setup(s1, rows=50)
+        s2 = make_session(tmp_path)
+        nodes = s1.catalog.active_nodes()
+        shard = s1.catalog.table_shards("t")[0]
+        cur = s1.catalog.active_placement(shard.shard_id).node_id
+        target = next(x for x in nodes if x.node_id != cur)
+        stop = threading.Event()
+
+        def reader():
+            checks = 0
+            while not stop.is_set():
+                assert totals(s2) == (n, sm)
+                checks += 1
+            return checks
+
+        t, out = run_thread(reader)
+        s1.execute(f"SELECT citus_move_shard_placement({shard.shard_id}, "
+                   f"'{target.name}')")
+        stop.set()
+        assert join_thread(t, out) > 0
+        assert s1.catalog.active_placement(shard.shard_id).node_id \
+            == target.node_id
+        assert totals(s1) == (n, sm)
+
+    def test_insert_during_rebalance_conserved(self, tmp_path):
+        s1 = make_session(tmp_path)
+        setup(s1, rows=30)
+        s2 = make_session(tmp_path)
+        # skew placements so the rebalancer makes real moves
+        nodes = s1.catalog.active_nodes()
+        for shard in s1.catalog.table_shards("t"):
+            s1.catalog.active_placement(shard.shard_id).node_id = \
+                nodes[0].node_id
+        s1.catalog._bump()
+        stop = threading.Event()
+        inserted = []
+
+        def inserter():
+            k = 500
+            while not stop.is_set():
+                s2.execute(f"INSERT INTO t VALUES ({k}, 1)")
+                inserted.append(k)
+                k += 1
+
+        t, out = run_thread(inserter)
+        s1.execute("SELECT citus_rebalance_start()")
+        s1.execute("SELECT citus_rebalance_wait()")
+        stop.set()
+        join_thread(t, out)
+        n, _sm = totals(s1)
+        assert n == 30 + len(inserted)
+
+    def test_failover_read_after_node_death(self, tmp_path):
+        # replication factor 2: killing one node's placements mid-loop
+        # must not break reads (catalog failover to the replica)
+        s1 = make_session(tmp_path, shard_replication_factor=2)
+        n, sm = setup(s1, rows=30)
+        assert totals(s1) == (n, sm)
+        victim = s1.catalog.active_nodes()[0]
+        s1.catalog.disable_node(victim.name)
+        assert totals(s1) == (n, sm)  # replicas answer
+
+
+# -- E. CDC × concurrent DML --------------------------------------------
+class TestCdcInterleavings:
+    def test_concurrent_writers_lsn_order_and_replay(self, tmp_path):
+        # two writers race; the change feed must still be a total order
+        # (strictly increasing LSNs) whose replay reproduces final state
+        s1 = make_session(tmp_path)
+        setup(s1, rows=0)
+        s2 = make_session(tmp_path)
+
+        def writer(s, base):
+            def go():
+                for i in range(8):
+                    s.execute(f"INSERT INTO t VALUES ({base + i}, "
+                              f"{(base + i) * 10})")
+            return go
+
+        t1, o1 = run_thread(writer(s1, 0))
+        t2, o2 = run_thread(writer(s2, 100))
+        join_thread(t1, o1)
+        join_thread(t2, o2)
+        events = s1.change_events("t")
+        lsns = [e["lsn"] for e in events]
+        assert lsns == sorted(lsns) and len(set(lsns)) == len(lsns)
+        inserted = set()
+        for e in events:
+            if e["kind"] == "insert":
+                values, _valid = s1.change_rows(e)
+                for v in values["id"]:
+                    inserted.add(int(v))
+        assert inserted == set(range(8)) | set(range(100, 108))
+
+    def test_feed_cutoff_is_prefix_consistent(self, tmp_path):
+        # reading the feed WHILE a writer commits: events up to any lsn
+        # form a prefix (no torn suffix, no out-of-order late arrivals)
+        s1 = make_session(tmp_path)
+        setup(s1, rows=0)
+        s2 = make_session(tmp_path)
+        stop = threading.Event()
+
+        def writer():
+            k = 0
+            while not stop.is_set():
+                s2.execute(f"INSERT INTO t VALUES ({k}, 1)")
+                k += 1
+            return k
+
+        t, out = run_thread(writer)
+        seen_max = 0
+        for _ in range(10):
+            events = s1.change_events("t")
+            lsns = [e["lsn"] for e in events]
+            assert lsns == sorted(lsns)
+            assert not lsns or lsns[-1] >= seen_max
+            seen_max = max(seen_max, lsns[-1] if lsns else 0)
+        stop.set()
+        total = join_thread(t, out)
+        assert len(s1.change_events("t")) == total
+
+
+# -- F. restore point × concurrent txn ----------------------------------
+class TestRestoreInterleavings:
+    def test_restore_point_excludes_inflight_txn(self, tmp_path):
+        from citus_tpu.operations.restore_point import restore_cluster
+
+        s1 = make_session(tmp_path / "d")
+        n, sm = setup(s1, rows=10)
+        s2 = make_session(tmp_path / "d")
+        s2.execute("BEGIN")
+        s2.execute("INSERT INTO t VALUES (999, 9990)")
+        s1.execute("SELECT citus_create_restore_point('cut')")
+        s2.execute("COMMIT")
+        assert totals(s1) == (n + 1, sm + 9990)
+        s1.close()
+        s2.close()
+        restore_cluster(str(tmp_path / "d"), "cut")
+        s3 = make_session(tmp_path / "d")
+        # the uncommitted-at-cut transaction is absent after restore
+        assert totals(s3) == (n, sm)
+
+    def test_restore_cut_is_atomic_under_concurrent_inserts(self,
+                                                            tmp_path):
+        from citus_tpu.operations.restore_point import restore_cluster
+
+        s1 = make_session(tmp_path / "d")
+        setup(s1, rows=0)
+        s2 = make_session(tmp_path / "d")
+        stop = threading.Event()
+
+        def inserter():
+            k = 0
+            while not stop.is_set():
+                s2.execute(f"INSERT INTO t VALUES ({k}, {k * 3})")
+                k += 1
+            return k
+
+        t, out = run_thread(inserter)
+        time.sleep(0.3)
+        s1.execute("SELECT citus_create_restore_point('mid')")
+        time.sleep(0.2)
+        stop.set()
+        total = join_thread(t, out)
+        s1.close()
+        s2.close()
+        restore_cluster(str(tmp_path / "d"), "mid")
+        s3 = make_session(tmp_path / "d")
+        n, sm = totals(s3)
+        # whole prefix of inserts: count k rows ⇒ ids 0..k-1 exactly
+        assert 0 <= n <= total
+        assert sm == sum(i * 3 for i in range(n)), \
+            "restored state is not a clean prefix of the insert stream"
+
+
+# -- G. health sweep × queries ------------------------------------------
+class TestHealthInterleavings:
+    def test_sweep_during_queries_no_false_positives(self, tmp_path):
+        from citus_tpu.operations import health
+
+        s1 = make_session(tmp_path)
+        n, sm = setup(s1, rows=20)
+        stop = threading.Event()
+
+        def reader():
+            checks = 0
+            while not stop.is_set():
+                assert totals(s1) == (n, sm)
+                checks += 1
+            return checks
+
+        t, out = run_thread(reader)
+        for _ in range(3):
+            assert health.health_sweep(s1) == []  # all nodes healthy
+        stop.set()
+        assert join_thread(t, out) > 0
+        assert all(node.is_active
+                   for node in s1.catalog.nodes.values())
+
+    def test_sweep_disables_dead_spare_while_queries_run(self, tmp_path):
+        from citus_tpu.operations import health
+
+        s1 = make_session(tmp_path)
+        n, sm = setup(s1, rows=20)
+        s1.catalog.add_node("device:99")  # beyond the mesh: dead
+        stop = threading.Event()
+
+        def reader():
+            while not stop.is_set():
+                assert totals(s1) == (n, sm)
+
+        t, out = run_thread(reader)
+        disabled = health.health_sweep(s1)
+        stop.set()
+        join_thread(t, out)
+        assert "device:99" in disabled
+        assert totals(s1) == (n, sm)
+
+
+# -- H. background jobs × DDL -------------------------------------------
+class TestBackgroundInterleavings:
+    def test_double_rebalance_start_is_safe(self, tmp_path):
+        s1 = make_session(tmp_path)
+        setup(s1, rows=20)
+        nodes = s1.catalog.active_nodes()
+        for shard in s1.catalog.table_shards("t"):
+            s1.catalog.active_placement(shard.shard_id).node_id = \
+                nodes[0].node_id
+        s1.catalog._bump()
+        s1.execute("SELECT citus_rebalance_start()")
+        s1.execute("SELECT citus_rebalance_start()")  # concurrent second
+        s1.execute("SELECT citus_rebalance_wait()")
+        # every shard still has exactly one active placement
+        for shard in s1.catalog.table_shards("t"):
+            active = [p for p in s1.catalog.placements.values()
+                      if p.shard_id == shard.shard_id
+                      and p.shard_state == "active"]
+            assert len(active) == 1, \
+                f"shard {shard.shard_id} has {len(active)} placements"
+
+    def test_drop_table_during_reads_clean_error(self, tmp_path):
+        # concurrent DROP: readers either answer from the pre-drop state
+        # or fail with a clean catalog error — never a crash/garbage
+        s1 = make_session(tmp_path)
+        n, sm = setup(s1, rows=20)
+        s2 = make_session(tmp_path)
+        stop = threading.Event()
+        clean = {"errors": 0, "ok": 0}
+
+        def reader():
+            while not stop.is_set():
+                try:
+                    assert totals(s2) == (n, sm)
+                    clean["ok"] += 1
+                except AssertionError:
+                    raise
+                except Exception:
+                    clean["errors"] += 1  # clean engine error is fine
+            return clean
+
+        t, out = run_thread(reader)
+        time.sleep(0.2)
+        s1.execute("DROP TABLE t")
+        stop.set()
+        join_thread(t, out)
+        assert clean["ok"] > 0
+
+
+# -- I. 2PC recovery × concurrent reads ---------------------------------
+class TestRecoveryInterleavings:
+    def test_recovery_rolls_forward_while_new_session_reads(self,
+                                                            tmp_path):
+        # crash between commit-record and apply: the NEXT session must
+        # roll the transaction forward; concurrent readers on that
+        # session see the rolled-forward state exactly once
+        from citus_tpu.utils import faultinjection as fi
+
+        s1 = make_session(tmp_path)
+        n, sm = setup(s1, rows=10)
+        s1.execute("BEGIN")
+        s1.execute("UPDATE t SET v = v + 1")
+        with fi.inject("txn.apply"):
+            with pytest.raises(Exception):
+                s1.execute("COMMIT")
+        s2 = make_session(tmp_path)  # triggers recovery
+
+        def reader():
+            return totals(s2)
+
+        threads = [run_thread(reader) for _ in range(3)]
+        results = [join_thread(t, o) for t, o in threads]
+        assert all(r == (n, sm + n) for r in results), results

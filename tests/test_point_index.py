@@ -1,0 +1,112 @@
+"""Persistent per-shard point-lookup index (storage/pkindex.py).
+
+Reference: columnar btree/hash index support
+(/root/reference/src/backend/columnar/README.md:176) — here point
+queries on the distribution column resolve via a sorted-key sidecar +
+chunk-local read instead of a shard scan.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import citus_tpu
+from citus_tpu.stats import counters as sc
+
+
+@pytest.fixture(scope="module")
+def sess(tmp_path_factory):
+    s = citus_tpu.connect(
+        data_dir=str(tmp_path_factory.mktemp("pki")),
+        n_devices=4, compute_dtype="float64")
+    s.execute("create table pt (k bigint, g bigint, v double precision, "
+              "name text)")
+    s.create_distributed_table("pt", "k", shard_count=4)
+    n = 200_000  # far above fast_path_max_rows per shard
+    rows = []
+    for i in range(0, n, 20000):
+        chunk = ",".join(
+            f"({j}, {j % 97}, {j}.25, 'n{j % 13}')"
+            for j in range(i, min(i + 20000, n)))
+        s.execute(f"insert into pt values {chunk}")
+    yield s, n
+    s.close()
+
+
+def _lookups(s):
+    return s.stats.counters.snapshot().get(sc.POINT_INDEX_LOOKUPS, 0)
+
+
+class TestPointIndex:
+    def test_point_query_uses_index(self, sess):
+        s, n = sess
+        before = _lookups(s)
+        r = s.execute("select k, g, v, name from pt where k = 123456")
+        assert r.rows() == [(123456, 123456 % 97, 123456.25,
+                             f"n{123456 % 13}")]
+        assert _lookups(s) == before + 1
+        assert r.fast_path
+
+    def test_residual_conjuncts_apply(self, sess):
+        s, n = sess
+        r = s.execute("select k from pt where k = 5000 and g = 0")
+        assert r.row_count == (1 if 5000 % 97 == 0 else 0)
+        r = s.execute(
+            f"select k from pt where k = 5000 and g = {5000 % 97}")
+        assert r.row_count == 1
+
+    def test_missing_key_returns_empty(self, sess):
+        s, n = sess
+        r = s.execute("select k from pt where k = 99999999")
+        assert r.row_count == 0
+
+    def test_warm_lookup_under_5ms(self, sess):
+        s, n = sess
+        s.execute("select k from pt where k = 777")  # build + warm
+        best = float("inf")
+        for i in range(10):
+            t0 = time.perf_counter()
+            r = s.execute(f"select k, v from pt where k = {1000 + i}")
+            best = min(best, time.perf_counter() - t0)
+            assert r.row_count == 1
+        assert best < 0.005, f"point lookup took {best * 1000:.2f} ms"
+
+    def test_index_persists_and_survives_restart(self, sess, tmp_path):
+        s, n = sess
+        import glob
+        import os
+
+        files = glob.glob(os.path.join(
+            s.data_dir, "tables", "pt", "shard_*", "PKIDX_k.npz"))
+        assert files, "index sidecar not persisted"
+
+    def test_dml_invalidates_index(self, sess):
+        s, n = sess
+        assert s.execute(
+            "select v from pt where k = 42").rows() == [(42.25,)]
+        s.execute("update pt set v = 1.5 where k = 42")
+        assert s.execute(
+            "select v from pt where k = 42").rows() == [(1.5,)]
+        s.execute("delete from pt where k = 42")
+        assert s.execute(
+            "select v from pt where k = 42").row_count == 0
+
+    def test_txn_overlay_bypasses_index(self, sess):
+        s, n = sess
+        s.execute("begin")
+        s.execute("insert into pt values (9000001, 1, 2.5, 'x')")
+        r = s.execute("select v from pt where k = 9000001")
+        assert r.row_count == 1  # staged row visible (index bypassed)
+        s.execute("rollback")
+        assert s.execute(
+            "select v from pt where k = 9000001").row_count == 0
+
+    def test_duplicate_keys_all_returned(self, sess):
+        s, n = sess
+        s.execute("insert into pt values (50, 1, 9.0, 'dup'), "
+                  "(50, 2, 10.0, 'dup')")
+        r = s.execute("select v from pt where k = 50")
+        got = sorted(float(x) for (x,) in r.rows())
+        assert got == [9.0, 10.0, 50.25]
+        s.execute("delete from pt where k = 50 and g in (1, 2)")

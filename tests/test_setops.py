@@ -88,6 +88,17 @@ class TestSetOps:
                          "union select x + 0.5 from b where x = 2")
         assert sorted(v for (v,) in r.rows()) == [1.0, 2.5]
 
+    def test_union_string_numeric_raises(self, sess):
+        # r4 advisor: PG raises "types cannot be matched"; a silently
+        # mixed-type object column is not an answer
+        with pytest.raises(PlanningError, match="cannot be matched"):
+            sess.execute("select y from a union select x from b")
+
+    def test_union_date_numeric_raises(self, sess):
+        sess.execute("create table dts (k bigint, d date)")
+        with pytest.raises(PlanningError, match="cannot be matched"):
+            sess.execute("select d from dts union select x from b")
+
 
 class TestCartesian:
     def test_cross_join_product(self, sess):
