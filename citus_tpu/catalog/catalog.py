@@ -573,7 +573,7 @@ class Catalog:
         atomic_write_json(path, self.to_json())
         try:
             st = os.stat(path)
-            self._disk_stat = (st.st_mtime_ns, st.st_size)
+            self._disk_stat = (st.st_mtime_ns, st.st_size, st.st_ino)
         except OSError:
             self._disk_stat = None
 
@@ -585,7 +585,7 @@ class Catalog:
             cat = Catalog.from_json(json.load(f))
         try:
             st = os.stat(path)
-            cat._disk_stat = (st.st_mtime_ns, st.st_size)
+            cat._disk_stat = (st.st_mtime_ns, st.st_size, st.st_ino)
         except OSError:
             cat._disk_stat = None
         return cat
@@ -600,7 +600,7 @@ class Catalog:
 
         try:
             st = os.stat(path)
-            disk = (st.st_mtime_ns, st.st_size)
+            disk = (st.st_mtime_ns, st.st_size, st.st_ino)
         except OSError:
             return False
         with self._lock:

@@ -155,6 +155,12 @@ _register(ConfigVar(
 
 # --- ingest ---------------------------------------------------------------
 _register(ConfigVar(
+    "copy_pipeline", True,
+    "Overlap COPY parsing with convert/compress/write via a bounded "
+    "producer queue (the per-shard stream overlap of the reference's "
+    "COPY, commands/multi_copy.c:315).",
+    bool))
+_register(ConfigVar(
     "copy_batch_rows", 65_536,
     "Rows parsed per ingest batch before routing "
     "(analogue of per-shard COPY buffering, commands/multi_copy.c).",

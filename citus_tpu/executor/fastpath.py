@@ -36,23 +36,21 @@ def _conjuncts(e):
     return [e]
 
 
-def index_probe(executor, node: ScanNode):
-    """The distribution-column equality constant when this scan can be
-    answered by the persistent point-lookup index (storage/pkindex.py —
-    the btree/hash-index analogue, columnar/README.md:176); else None."""
-    if not executor.settings.get("enable_point_lookup_index"):
+def point_lookup_const(node: ScanNode, catalog, settings=None):
+    """STRUCTURAL point-index eligibility: the distribution-column
+    equality constant when the plan shape qualifies for the persistent
+    point-lookup index (storage/pkindex.py — the btree/hash-index
+    analogue, columnar/README.md:176); else None.  Shared by the
+    executor and EXPLAIN so the plan display cannot drift from the
+    runtime's matcher; the executor's index_probe adds the
+    instant-dependent overlay check on top."""
+    if settings is not None and \
+            not settings.get("enable_point_lookup_index"):
         return None
     if node.filter is None or node.pruned_shards is None or \
             len(node.pruned_shards) != 1:
         return None
-    store = executor.store
-    if store.overlay is not None and (
-            any(t == node.rel.table for (t, _s) in store.overlay.records)):
-        # transaction-staged rows bypass the index; report ineligible
-        # HERE so the row-ceiling gate above doesn't assume an indexed
-        # answer and then fall through to an unbounded shard scan
-        return None
-    meta = executor.catalog.table(node.rel.table)
+    meta = catalog.table(node.rel.table)
     if meta.method != DistributionMethod.HASH:
         return None
     dcol = meta.distribution_column
@@ -70,6 +68,21 @@ def index_probe(executor, node: ScanNode):
                     and isinstance(const.value, (int, np.integer)):
                 return int(const.value)
     return None
+
+
+def index_probe(executor, node: ScanNode):
+    """point_lookup_const + this session's transaction state: staged
+    overlay rows are invisible to the index, so report ineligible here
+    and the row-ceiling gate counts the shard instead of assuming an
+    indexed answer."""
+    value = point_lookup_const(node, executor.catalog, executor.settings)
+    if value is None:
+        return None
+    store = executor.store
+    if store.overlay is not None and (
+            any(t == node.rel.table for (t, _s) in store.overlay.records)):
+        return None
+    return value
 
 
 def fast_path_shape(plan: QueryPlan, catalog) -> bool:

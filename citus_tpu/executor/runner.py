@@ -273,38 +273,66 @@ class Executor:
                 caps = caps.grown(cap_overflow)
 
     # ------------------------------------------------------------------
-    CAPS_MEMO_VERSION = 3  # bump when capacity semantics change
+    CAPS_MEMO_VERSION = 4  # bump when capacity semantics change
 
     def _memo_path(self) -> str:
         import os
 
-        return os.path.join(self.store.data_dir, "caps_memo.pkl")
+        return os.path.join(self.store.data_dir, "caps_memo.json")
+
+    # the memo is plain tuples/dicts of ints, strings, bools and Nones —
+    # JSON round-trips it (lists→tuples, int keys re-parsed) without the
+    # arbitrary-code-execution hazard pickle.load would add to a SHARED
+    # data_dir (every other persisted artifact here is JSON for the same
+    # reason)
+    @staticmethod
+    def _memo_to_json(obj):
+        if isinstance(obj, tuple):
+            return {"t": [Executor._memo_to_json(x) for x in obj]}
+        if isinstance(obj, dict):
+            return {"d": [[Executor._memo_to_json(k),
+                           Executor._memo_to_json(v)]
+                          for k, v in obj.items()]}
+        return obj
+
+    @staticmethod
+    def _memo_from_json(obj):
+        if isinstance(obj, dict) and "t" in obj:
+            return tuple(Executor._memo_from_json(x) for x in obj["t"])
+        if isinstance(obj, dict) and "d" in obj:
+            return {Executor._memo_from_json(k):
+                    Executor._memo_from_json(v) for k, v in obj["d"]}
+        return obj
 
     def _load_caps_memo(self) -> dict:
-        import pickle
+        import json as _json
 
         try:
-            with open(self._memo_path(), "rb") as f:
-                obj = pickle.load(f)
+            with open(self._memo_path()) as f:
+                obj = _json.load(f)
             if obj.get("version") == self.CAPS_MEMO_VERSION:
-                return obj["memo"]
+                return {self._memo_from_json(k): self._memo_from_json(v)
+                        for k, v in obj["memo"]}
         except Exception:
             pass
         return {}
 
     def _memoize_caps(self, fingerprint, plan: QueryPlan,
                       caps: Capacities) -> None:
+        import json as _json
         import os
-        import pickle
 
         if len(self._caps_memo) > 512:
             self._caps_memo.clear()
         self._caps_memo[fingerprint] = self._caps_to_order(plan, caps)
         try:
             tmp = self._memo_path() + ".tmp"
-            with open(tmp, "wb") as f:
-                pickle.dump({"version": self.CAPS_MEMO_VERSION,
-                             "memo": self._caps_memo}, f)
+            with open(tmp, "w") as f:
+                _json.dump(
+                    {"version": self.CAPS_MEMO_VERSION,
+                     "memo": [[self._memo_to_json(k),
+                               self._memo_to_json(v)]
+                              for k, v in self._caps_memo.items()]}, f)
             os.replace(tmp, self._memo_path())
         except Exception:
             pass  # persistence is best-effort; in-memory memo suffices

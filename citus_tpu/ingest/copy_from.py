@@ -32,10 +32,59 @@ def copy_from(session, stmt: ast.CopyFrom):
 
     from .parse import iter_text_batches
 
-    for batch in iter_text_batches(stmt.path, delimiter, stmt.header,
-                                   stmt.null_string, len(columns),
-                                   batch_rows):
-        total += _ingest_batch(session, stmt.table, columns, batch)[0]
+    batches = iter_text_batches(stmt.path, delimiter, stmt.header,
+                                stmt.null_string, len(columns),
+                                batch_rows)
+    if not session.settings.get("copy_pipeline"):
+        for batch in batches:
+            total += _ingest_batch(session, stmt.table, columns, batch)[0]
+        return ResultSet(["copied"], {"copied": [total]}, 1)
+
+    # pipelined ingest: a producer thread PARSES batch N+1 while this
+    # thread converts/routes/compresses/writes batch N (the per-shard
+    # stream overlap of the reference's COPY, commands/multi_copy.c:315).
+    # The bounded queue caps memory at two parsed batches; zstd releases
+    # the GIL, so on a multi-core host the parse leg hides entirely
+    # behind compression (on this 1-core rig the overlap is a wash —
+    # PERF_NOTES 'Pipelined COPY').
+    import queue
+    import threading
+
+    q: queue.Queue = queue.Queue(maxsize=2)
+    stop = threading.Event()  # consumer error → producer exits promptly
+
+    def _put(item) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def producer():
+        try:
+            for batch in batches:
+                if not _put(("batch", batch)):
+                    return
+            _put(("done", None))
+        except Exception as e:  # surfaced on the consumer side
+            _put(("err", e))
+
+    t = threading.Thread(target=producer, daemon=True)
+    t.start()
+    try:
+        while True:
+            kind, payload = q.get()
+            if kind == "err":
+                raise payload
+            if kind == "done":
+                break
+            total += _ingest_batch(session, stmt.table, columns,
+                                   payload)[0]
+    finally:
+        stop.set()  # a mid-parse producer stops at its next put attempt
+        t.join(timeout=10.0)
     return ResultSet(["copied"], {"copied": [total]}, 1)
 
 

@@ -58,16 +58,28 @@ def format_plan(plan: QueryPlan, catalog: Catalog,
     if enabled and fast_path_shape(plan, catalog):
         lines.append("  Fast Path Router: single-shard host execution "
                      "(below fast_path_max_rows)")
-    _format_node(plan.root, lines, 1)
+    _format_node(plan.root, lines, 1, catalog, settings)
     return lines
 
 
-def _format_node(node: PlanNode, lines: list[str], depth: int) -> None:
+def _point_index_eligible(node: ScanNode, catalog, settings) -> bool:
+    """The runtime's own structural matcher (no store/overlay state —
+    EXPLAIN shows the plan's shape, not this instant's transaction)."""
+    from ..executor.fastpath import point_lookup_const
+
+    return point_lookup_const(node, catalog, settings) is not None
+
+
+def _format_node(node: PlanNode, lines: list[str], depth: int,
+                 catalog=None, settings=None) -> None:
     pad = "  " * depth
     if isinstance(node, ScanNode):
         extra = ""
         if node.pruned_shards is not None:
             extra = f"  (shards pruned to {node.pruned_shards})"
+        if catalog is not None and \
+                _point_index_eligible(node, catalog, settings):
+            extra += "  (point index lookup)"
         lines.append(f"{pad}-> Columnar Scan on {node.rel.table} "
                      f"[{node.dist.kind}]{extra}")
         if node.filter is not None:
@@ -76,7 +88,8 @@ def _format_node(node: PlanNode, lines: list[str], depth: int) -> None:
     if isinstance(node, ProjectNode):
         exprs = ", ".join(f"{e} AS {cid}" for e, cid in node.exprs)
         lines.append(f"{pad}-> Project [{exprs}]")
-        _format_node(node.input, lines, depth + 1)
+        _format_node(node.input, lines, depth + 1, catalog,
+                     settings)
         return
     if isinstance(node, JoinNode):
         label = _JOIN_LABEL.get(node.strategy, node.strategy)
@@ -105,15 +118,18 @@ def _format_node(node: PlanNode, lines: list[str], depth: int) -> None:
                      f"{', fused lookup' if node.fuse_lookup else ''}]")
         if node.residual is not None:
             lines.append(f"{pad}     Residual: {node.residual}")
-        _format_node(node.left, lines, depth + 1)
-        _format_node(node.right, lines, depth + 1)
+        _format_node(node.left, lines, depth + 1, catalog,
+                     settings)
+        _format_node(node.right, lines, depth + 1, catalog,
+                     settings)
         return
     if isinstance(node, WindowNode):
         combine = {"local": "device-local partitions",
                    "repartition": "all_to_all partitions"}[node.combine]
         fns = ", ".join(str(w) for w, _ in node.functions)
         lines.append(f"{pad}-> WindowAgg [{combine}] {fns}")
-        _format_node(node.input, lines, depth + 1)
+        _format_node(node.input, lines, depth + 1, catalog,
+                     settings)
         return
     if isinstance(node, AggregateNode):
         combine = {"local": "device-local groups",
@@ -123,6 +139,7 @@ def _format_node(node: PlanNode, lines: list[str], depth: int) -> None:
         aggs = ", ".join(str(a) for a, _ in node.aggs)
         lines.append(f"{pad}-> GroupAggregate [{combine}] "
                      f"keys: {keys}  aggs: {aggs}")
-        _format_node(node.input, lines, depth + 1)
+        _format_node(node.input, lines, depth + 1, catalog,
+                     settings)
         return
     lines.append(f"{pad}-> {type(node).__name__}")

@@ -41,9 +41,12 @@ def _idx_path(store, table: str, shard_id: int, column: str) -> str:
 
 def _load(path: str):
     try:
-        with np.load(path, allow_pickle=True) as z:
-            return (z["keys"], z["stripe_idx"], z["row_pos"],
-                    [tuple(x) for x in z["sig"]])
+        # allow_pickle stays False (numpy default): the sidecar sits in
+        # a possibly-shared data_dir and must never execute code on load
+        with np.load(path) as z:
+            sig = [(str(f), int(r))
+                   for f, r in zip(z["sig_files"], z["sig_rows"])]
+            return (z["keys"], z["stripe_idx"], z["row_pos"], sig)
     except Exception:
         return None
 
@@ -76,31 +79,51 @@ def _build(store, table: str, shard_id: int, column: str, records):
     return keys, sidx, rpos
 
 
+def _cache(store) -> dict:
+    c = getattr(store, "_pkidx_cache", None)
+    if c is None:
+        c = store._pkidx_cache = {}
+    return c
+
+
 def lookup(store, table: str, shard_id: int, column: str,
            value: int):
     """Positions of rows where column == value, as
     [(stripe_record, row_pos array)]; None when the index cannot be
-    used (overlay data present).  Builds/rebuilds the sidecar lazily."""
+    used (overlay data present).  Builds/rebuilds the sidecar lazily.
+
+    Warm lookups come from an in-memory cache validated against the
+    manifest stripe signature — re-decompressing the sidecar per query
+    would cost more than the binary search it enables."""
     if store.overlay is not None and (
             store._overlay_records(table, shard_id)
             or any(t == table for (t, _s) in store.overlay.records)):
         return None
     records = store.manifest(table)["shards"].get(str(shard_id), [])
     sig = _sig(records)
-    path = _idx_path(store, table, shard_id, column)
-    loaded = _load(path)
-    if loaded is not None and loaded[3] == sig:
-        keys, sidx, rpos = loaded[:3]
+    ckey = (table, shard_id, column)
+    cached = _cache(store).get(ckey)
+    if cached is not None and cached[3] == sig:
+        keys, sidx, rpos = cached[:3]
     else:
-        keys, sidx, rpos = _build(store, table, shard_id, column, records)
-        try:
-            os.makedirs(os.path.dirname(path), exist_ok=True)
-            tmp = path + ".tmp.npz"
-            np.savez(tmp, keys=keys, stripe_idx=sidx, row_pos=rpos,
-                     sig=np.asarray(sig, dtype=object))
-            os.replace(tmp, path)
-        except OSError:
-            pass  # persistence is best-effort; in-memory result is valid
+        path = _idx_path(store, table, shard_id, column)
+        loaded = _load(path)
+        if loaded is not None and loaded[3] == sig:
+            keys, sidx, rpos = loaded[:3]
+        else:
+            keys, sidx, rpos = _build(store, table, shard_id, column,
+                                      records)
+            try:
+                os.makedirs(os.path.dirname(path), exist_ok=True)
+                tmp = path + ".tmp.npz"
+                files = np.asarray([f for f, _r in sig])
+                rows = np.asarray([r for _f, r in sig], dtype=np.int64)
+                np.savez(tmp, keys=keys, stripe_idx=sidx, row_pos=rpos,
+                         sig_files=files, sig_rows=rows)
+                os.replace(tmp, path)
+            except OSError:
+                pass  # persistence is best-effort; memory result valid
+        _cache(store)[ckey] = (keys, sidx, rpos, sig)
     lo = int(np.searchsorted(keys, value, side="left"))
     hi = int(np.searchsorted(keys, value, side="right"))
     out = []

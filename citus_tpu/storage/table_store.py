@@ -124,7 +124,11 @@ class TableStore:
         """Remember the on-disk manifest's identity (caller holds lock)."""
         try:
             st = os.stat(self._manifest_path(table))
-            self._manifest_stats[table] = (st.st_mtime_ns, st.st_size)
+            # inode included: atomic_write_json renames a fresh file per
+            # commit, so two same-size commits inside one mtime tick
+            # still change identity (review: lost-visibility hole)
+            self._manifest_stats[table] = (st.st_mtime_ns, st.st_size,
+                                           st.st_ino)
         except OSError:
             self._manifest_stats.pop(table, None)
 
@@ -140,7 +144,7 @@ class TableStore:
                 return False  # next read loads from disk anyway
             try:
                 st = os.stat(self._manifest_path(table))
-                disk = (st.st_mtime_ns, st.st_size)
+                disk = (st.st_mtime_ns, st.st_size, st.st_ino)
             except OSError:
                 disk = None
             if self._manifest_stats.get(table) == disk:

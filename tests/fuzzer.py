@@ -204,6 +204,64 @@ def _rand_corr_subquery(rng: random.Random, tables):
             f"where {cond})", outer_tab)
 
 
+UNIQUE_KEYS = {"lineitem": ["l_orderkey", "l_linenumber"],
+               "orders": ["o_orderkey"], "customer": ["c_custkey"],
+               "supplier": ["s_suppkey"], "nation": ["n_nationkey"],
+               "part": ["p_partkey"]}
+
+
+def _rand_window(rng: random.Random, tables) -> str | None:
+    """A deterministic window expression over the current FROM (windows
+    over joins exercise the shuffle + segmented-scan machinery).  Values
+    must not depend on tie-breaking: ranking functions order by the
+    joined tables' unique keys (total order), and running aggregates use
+    int columns (no float accumulation-order wobble)."""
+    keys = []
+    for t in tables:
+        keys.extend(UNIQUE_KEYS[t])
+    order = ", ".join(keys)
+    part_pool = [c for t in tables for c, k in TABLES[t]
+                 if k in ("int", "str") and c not in keys]
+    part = rng.choice(part_pool) if part_pool else None
+    kind = rng.choice(["row_number", "rank", "dense_rank", "sum_run",
+                       "count_part", "sum_part"])
+    over_po = (f"partition by {part} " if part and rng.random() < 0.7
+               else "")
+    if kind in ("row_number", "rank", "dense_rank"):
+        fn = kind
+        return f"{fn}() over ({over_po}order by {order})"
+    int_cols = [c for t in tables for c, k in TABLES[t] if k == "int"]
+    col = rng.choice(int_cols)
+    if kind == "sum_run":
+        return f"sum({col}) over ({over_po}order by {order})"
+    if part is None:
+        return None
+    if kind == "count_part":
+        return f"count(*) over (partition by {part})"
+    return f"sum({col}) over (partition by {part})"
+
+
+def _rand_setop_in_subquery(rng: random.Random, tables) -> str | None:
+    """`col IN (select a from t1 UNION/INTERSECT/EXCEPT select b from
+    t2)` — set operations nested under a subquery (r4 VERDICT #9)."""
+    int_cols = [c for t in tables for c, k in TABLES[t] if k == "int"]
+    others = [t for t in TABLES if t not in tables]
+    if not int_cols or len(others) < 2:
+        return None
+    col = rng.choice(int_cols)
+    t1, t2 = rng.sample(others, 2)
+    c1 = rng.choice([c for c, k in TABLES[t1] if k == "int"])
+    c2 = rng.choice([c for c, k in TABLES[t2] if k == "int"])
+    sides = [f"select {c1} from {t1}", f"select {c2} from {t2}"]
+    for i, t in enumerate((t1, t2)):
+        flt = _rand_filter(rng, [t])
+        if flt and rng.random() < 0.5:
+            sides[i] += f" where {flt}"
+    op = rng.choice(["union", "union all", "intersect", "except"])
+    neg = "not " if rng.random() < 0.3 else ""
+    return f"{col} {neg}in ({sides[0]} {op} {sides[1]})"
+
+
 def generate(rng: random.Random) -> Fuzz:
     start = rng.choice(list(TABLES))
     tables = [start]
@@ -235,6 +293,10 @@ def generate(rng: random.Random) -> Fuzz:
         sub = _rand_corr_subquery(rng, tables)
         if sub:
             f.subqueries.append(sub)
+    if rng.random() < 0.2:
+        frag = _rand_setop_in_subquery(rng, tables)
+        if frag:
+            f.filters.append(frag)
 
     cols = _columns_of(tables)
     if rng.random() < 0.65:  # aggregate mode
@@ -262,7 +324,13 @@ def generate(rng: random.Random) -> Fuzz:
     else:  # plain projection mode
         rng.shuffle(cols)
         f.plain_select = [c for c, _ in cols[:rng.choice([1, 2, 3])]]
-        if rng.random() < 0.25 and not f.joins and not f.subqueries:
+        if rng.random() < 0.3:
+            w = _rand_window(rng, tables)
+            if w:
+                f.plain_select.append(w)
+        if rng.random() < 0.25 and not f.joins and not f.subqueries \
+                and len(f.plain_select) == len(
+                    [c for c in f.plain_select if "(" not in c]):
             # set-operation tail over kind-compatible columns of another
             # table (multiset comparison — no ORDER BY needed)
             kinds = [k for c, k in TABLES[f.tables[0]]

@@ -58,6 +58,7 @@ PLANS = {
         "and l2.l_suppkey <> l1.l_suppkey)",
     "cartesian_product":
         "select count(*) from supplier, part",
+    "q15_cte_top_supplier": tpch.Q15,
 }
 
 
