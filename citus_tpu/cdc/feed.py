@@ -223,6 +223,10 @@ def rows_for(store, event: dict):
     events only).  Late materialization keeps the journal small."""
     table = event["table"]
     shard_id = event["shard_id"]
+    # the journal is shared across sessions but the manifest cache is
+    # per-session: an event another session just committed may reference
+    # a stripe our cache predates — adopt the on-disk manifest first
+    store.refresh_if_stale(table)
     vals, mask, _n, _dm = store.read_stripe_raw(table, shard_id,
                                                 event["file"])
     if event["kind"] == "insert":

@@ -319,21 +319,25 @@ class Executor:
 
     def _memoize_caps(self, fingerprint, plan: QueryPlan,
                       caps: Capacities) -> None:
-        import json as _json
+        import contextlib
         import os
+
+        from ..utils.io import atomic_write_json
 
         if len(self._caps_memo) > 512:
             self._caps_memo.clear()
         self._caps_memo[fingerprint] = self._caps_to_order(plan, caps)
         try:
-            tmp = self._memo_path() + ".tmp"
-            with open(tmp, "w") as f:
-                _json.dump(
-                    {"version": self.CAPS_MEMO_VERSION,
-                     "memo": [[self._memo_to_json(k),
-                               self._memo_to_json(v)]
-                              for k, v in self._caps_memo.items()]}, f)
-            os.replace(tmp, self._memo_path())
+            atomic_write_json(
+                self._memo_path(),
+                {"version": self.CAPS_MEMO_VERSION,
+                 "memo": [[self._memo_to_json(k), self._memo_to_json(v)]
+                          for k, v in self._caps_memo.items()]})
+            # complete the pkl→json migration: the pickle predecessor
+            # must not linger in a shared data_dir
+            with contextlib.suppress(OSError):
+                os.unlink(os.path.join(self.store.data_dir,
+                                       "caps_memo.pkl"))
         except Exception:
             pass  # persistence is best-effort; in-memory memo suffices
 

@@ -1069,6 +1069,15 @@ class DistributedPlanner:
                 cid = f"agg{len(aggs)}"
                 aggs.append((a, cid))
                 out = ir.BCol(cid, a.dtype)
+                if a.kind in ("count", "count_star"):
+                    # SQL count is NEVER NULL — but the distinct/approx
+                    # splits re-aggregate partial counts as sum, and sum
+                    # over an EMPTY input is NULL (fuzz catch: mixed
+                    # count + count(distinct) over zero rows)
+                    out = ir.BCase(
+                        ((ir.BIsNull(out),
+                          ir.BConst(0, DataType.INT64)),),
+                        out, DataType.INT64)
             agg_map[a] = out
             return out
 

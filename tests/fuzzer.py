@@ -329,8 +329,7 @@ def generate(rng: random.Random) -> Fuzz:
             if w:
                 f.plain_select.append(w)
         if rng.random() < 0.25 and not f.joins and not f.subqueries \
-                and len(f.plain_select) == len(
-                    [c for c in f.plain_select if "(" not in c]):
+                and all("(" not in c for c in f.plain_select):
             # set-operation tail over kind-compatible columns of another
             # table (multiset comparison — no ORDER BY needed)
             kinds = [k for c, k in TABLES[f.tables[0]]

@@ -464,3 +464,23 @@ def test_stale_group_key_range_retries_on_packed_sort(sess):
     assert result.retries >= 1
     rows = sorted(result.rows())
     assert rows == [(1, 1, 10), (2, 1, 20), (7, 2, 30), (8, 2, 40)]
+
+
+def test_mixed_count_and_distinct_over_empty_input(sess):
+    """Fuzz catch (seed 20260730 #47): count(col) re-aggregated as sum
+    through the DISTINCT split returned NULL over zero rows; SQL count
+    is never NULL."""
+    sess.execute("create table ce (k bigint, a bigint, b bigint)")
+    sess.create_distributed_table("ce", "k", shard_count=4)
+    sess.execute("insert into ce values (1, 2, 3), (4, 5, 6)")
+    r = sess.execute("select count(a), count(distinct a) from ce "
+                     "where b > 100").rows()[0]
+    assert r == (0, 0), r
+    # approx split re-aggregates plain counts the same way
+    r = sess.execute("select approx_count_distinct(a), count(b) from ce "
+                     "where b > 100").rows()[0]
+    assert r == (0, 0), r
+    # non-empty sanity
+    r = sess.execute(
+        "select count(a), count(distinct a) from ce").rows()[0]
+    assert r == (2, 2), r
