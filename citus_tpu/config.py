@@ -83,6 +83,21 @@ _register(ConfigVar(
     "Static aggregate-output headroom over the estimated group count.",
     float, min_value=1.0, max_value=64.0))
 _register(ConfigVar(
+    "join_probe_bucket_factor", 2.0,
+    "Per-bucket probe-slot headroom over the uniform-hash expectation "
+    "for bucketed fused lookups (ops.join.bucketed_unique_lookup). "
+    "Skewed buckets overflow and regrow through the normal retry path; "
+    "capacity feedback tightens converged sizes.",
+    float, min_value=1.0, max_value=64.0))
+_register(ConfigVar(
+    "join_probe_kernel", "xla",
+    "Bucketed-probe inner formulation: 'xla' (batched take_along_axis) "
+    "or 'pallas' (tile-resident VMEM kernel, ops/pallas_kernels.py). "
+    "bench_kernels.bench_probe() A/Bs both on the target hardware; the "
+    "default stays xla until a measurement says otherwise (same "
+    "contract as the aggregation kernel).",
+    str, choices=("xla", "pallas")))
+_register(ConfigVar(
     "enable_capacity_feedback", True,
     "After a clean execution, shrink buffers whose recorded actual row "
     "counts sit far below the planner's estimate and recompile once "

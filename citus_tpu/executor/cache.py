@@ -63,7 +63,8 @@ def node_fingerprint(node: PlanNode) -> str:
         return (f"J({node.strategy};{node.join_type};{node.repart_key_idx};"
                 f"{node.build_side};{node.left_key_extents};"
                 f"{node.right_key_extents};{node.key_int32};"
-                f"{node.fuse_lookup};{node.flag_combine};"
+                f"{node.fuse_lookup};{node.probe_bucketed};"
+                f"{node.flag_combine};"
                 f"{node_fingerprint(node.left)};"
                 f"{node_fingerprint(node.right)};"
                 f"{[repr(k) for k in node.left_keys]};"
@@ -100,7 +101,9 @@ def caps_signature(plan: QueryPlan, caps) -> tuple:
             tuple(sorted((order[k], v) for k, v in caps.agg_out.items())),
             caps.dense_off,
             tuple(sorted((order[k], v) for k, v in caps.scan_out.items())),
-            caps.output_repart)
+            caps.output_repart,
+            tuple(sorted((order[k], v)
+                         for k, v in caps.bucket_probe.items())))
 
 
 def feeds_signature(plan: QueryPlan, feeds) -> tuple:

@@ -28,7 +28,21 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+try:  # jax >= 0.5 exports shard_map at top level
+    from jax import shard_map as _shard_map
+except ImportError:  # jax 0.4.x: experimental namespace
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+
+def shard_map(body, mesh, in_specs, out_specs, check_vma=False):
+    """Version-compat shim: the replication-check kwarg was renamed
+    check_rep → check_vma across jax releases."""
+    try:
+        return _shard_map(body, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_vma=check_vma)
+    except TypeError:
+        return _shard_map(body, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=check_vma)
 
 from ..catalog.distribution import HASH_TOKEN_COUNT, INT32_MIN
 from ..errors import ExecutionError, PlanningError
@@ -211,12 +225,18 @@ class Capacities:
     # per-(source, target) bucket slots for the INSERT..SELECT output
     # shuffle (QueryPlan.output_repart); None when the plan has none
     output_repart: int | None = None
+    # per-bucket probe slots for bucketed fused lookups (JoinNode.
+    # probe_bucketed): the packed probe buffer is [n_buckets, this];
+    # skew overflows and regrows through the normal retry path
+    bucket_probe: dict[int, int] = None
 
     def __post_init__(self):
         if self.agg_out is None:
             self.agg_out = {}
         if self.scan_out is None:
             self.scan_out = {}
+        if self.bucket_probe is None:
+            self.bucket_probe = {}
 
     def grown(self, overflow: int) -> "Capacities":
         """Retry sizing: at least double, and at least enough for the
@@ -232,7 +252,8 @@ class Capacities:
                           self.dense_off,
                           {k: g(v) for k, v in self.scan_out.items()},
                           g(self.output_repart)
-                          if self.output_repart else None)
+                          if self.output_repart else None,
+                          {k: g(v) for k, v in self.bucket_probe.items()})
 
 
 class PlanCompiler:
@@ -240,13 +261,17 @@ class PlanCompiler:
 
     def __init__(self, plan: QueryPlan, mesh: Mesh,
                  feeds: dict[int, FeedSpec], caps: Capacities,
-                 compute_dtype=np.float32):
+                 compute_dtype=np.float32, probe_kernel: str = "xla"):
         self.plan = plan
         self.mesh = mesh
         self.feeds = feeds
         self.caps = caps
         self.n_dev = plan.n_devices
         self.compute_dtype = compute_dtype
+        # bucketed-probe inner formulation ('xla' | 'pallas'): a
+        # hardware-measured choice (bench_kernels.bench_probe), part of
+        # the plan-cache key in the runner
+        self.probe_kernel = probe_kernel
 
     # ------------------------------------------------------------------
     def build(self):
@@ -958,7 +983,8 @@ class PlanCompiler:
         probe with >1 match means the planner's uniqueness claim was
         stale: the surplus is reported as dense_oob so the host retries
         on the general expansion path (never silently dropped pairs)."""
-        from ..ops.join import _bounds, dense_unique_lookup
+        from ..ops.join import (_bounds, bucketed_unique_lookup,
+                                dense_unique_lookup)
 
         if node.join_type == "inner" and \
                 getattr(node, "build_side", "right") == "left":
@@ -970,7 +996,22 @@ class PlanCompiler:
             pblk, pkeys, pmatch = lblk, lkeys, lmatch
             extents = getattr(node, "right_key_extents", ())
         dense = self._dense_for(extents, bkeys)
-        if dense is not None and len(bkeys) == 1:
+        bucket_cap = (self.caps.bucket_probe.get(id(node))
+                      if getattr(node, "probe_bucketed", False) else None)
+        if dense is not None and len(bkeys) == 1 and bucket_cap is not None:
+            # bucketed probe (the planner's size-threshold pick for
+            # large directories): pack probes by VMEM-sized directory
+            # tile, probe tile-locally — random HBM gathers become
+            # streaming tile traffic.  Same oob/duplicate retry contract
+            # as the single gather; bucket skew overflows → grown retry.
+            bidx, counts, dense_oob, boverflow, bfill = \
+                bucketed_unique_lookup(bkeys[0], bmatch, pkeys[0],
+                                       dense[0], dense[1], bucket_cap,
+                                       kernel=self.probe_kernel)
+            self._overflow = self._overflow + boverflow
+            self._record(id(node), "bucket_probe", bfill, bucket_cap)
+            counts = jnp.where(pmatch, counts, 0)
+        elif dense is not None and len(bkeys) == 1:
             # unique build key (the fused-lookup planner claim): scatter
             # directory, NO build-side argsort per execution
             bidx, counts, dense_oob = dense_unique_lookup(

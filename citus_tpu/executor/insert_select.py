@@ -116,7 +116,8 @@ def execute_insert_select(session, stmt):
             # device-partitioned end-to-end — host routing below
             plan.output_repart = None
         n = _write_result(session, meta, columns, result, mode,
-                          device_routed=plan.output_repart is not None)
+                          device_routed=plan.output_repart is not None,
+                          plan_catalog_version=plan.catalog_version)
         stats = getattr(session, "stats", None)
         if stats is not None:
             from ..stats import counters as sc
@@ -260,11 +261,35 @@ def _device_shard_map(session, meta):
 
 
 def _write_result(session, meta, columns, result, mode="repartition",
-                  device_routed: bool = False) -> int:
+                  device_routed: bool = False,
+                  plan_catalog_version: int | None = None) -> int:
     n = result.row_count
     if n == 0:
         return 0
     typed, validity = _target_arrays(session, meta, columns, result)
+    # Every write happens under the DML shard locks (the shard split
+    # holds them while it flips the catalog), with _dml_locks' reload
+    # loop adopting the committed catalog before we route — otherwise a
+    # split committing between routing and append sends rows into the
+    # dropped parent shard (lost).  Device-pre-partitioned writes
+    # (colocated slices, device-routed repartition) additionally trust
+    # routing DERIVED AT PLAN TIME: if the catalog moved since planning,
+    # demote to host hash-routing — per-row re-hash against the CURRENT
+    # shard map is correct under any split.
+    table = meta.name
+    with session._dml_locks(
+            table, lambda: session.catalog.table_shards(table)):
+        if (device_routed or mode == "colocated") and \
+                plan_catalog_version is not None and \
+                session.catalog.version != plan_catalog_version:
+            mode, device_routed = "repartition", False
+        return _route_and_write(session, meta, columns, typed, validity,
+                                result, mode, device_routed)
+
+
+def _route_and_write(session, meta, columns, typed, validity, result,
+                     mode, device_routed) -> int:
+    n = result.row_count
     codec = session.settings.get("columnar_compression")
     level = session.settings.get("columnar_compression_level")
     chunk_rows = session.settings.get("columnar_chunk_group_row_limit")

@@ -189,11 +189,13 @@ class Executor:
                         f"{limit / 1e9:.1f} GB) — usually a cartesian "
                         "or extreme-fanout join; rewrite the query or "
                         "raise the limit")
-            key = fingerprint + (caps_signature(plan, caps),)
+            probe_kernel = self.settings.get("join_probe_kernel")
+            key = fingerprint + (caps_signature(plan, caps), probe_kernel)
             entry = self.plan_cache.get(key)
             if entry is None:
                 compiler = PlanCompiler(plan, self.mesh, feeds, caps,
-                                        compute_dtype)
+                                        compute_dtype,
+                                        probe_kernel=probe_kernel)
                 fn, feed_arrays, out_meta, stage_keys = compiler.build()
                 self.plan_cache.put(key, (fn, out_meta, stage_keys))
             else:
@@ -268,12 +270,14 @@ class Executor:
                     scan_out={k: max(v, caps.scan_out.get(k, 0))
                               for k, v in fresh.scan_out.items()},
                     output_repart=max(fresh.output_repart or 0,
-                                      caps.output_repart or 0) or None)
+                                      caps.output_repart or 0) or None,
+                    bucket_probe={k: max(v, caps.bucket_probe.get(k, 0))
+                                  for k, v in fresh.bucket_probe.items()})
             if cap_overflow:
                 caps = caps.grown(cap_overflow)
 
     # ------------------------------------------------------------------
-    CAPS_MEMO_VERSION = 4  # bump when capacity semantics change
+    CAPS_MEMO_VERSION = 5  # bump when capacity semantics change
 
     def _memo_path(self) -> str:
         import os
@@ -353,6 +357,7 @@ class Executor:
     # SLOWER on Q3 SF10.  Compaction must shrink ≥3× to pay for itself.
     TIGHTEN_SLACK = 1.3
     TIGHTEN_THRESHOLD = {"repartition": 0.85, "agg_out": 0.85,
+                         "bucket_probe": 0.85,
                          "scan_out": 1.0 / 3.0, "join_out": 1.0 / 3.0}
 
     def _tighten_caps(self, plan: QueryPlan, caps: Capacities,
@@ -367,7 +372,8 @@ class Executor:
         new = {"repartition": dict(caps.repartition),
                "join_out": dict(caps.join_out),
                "agg_out": dict(caps.agg_out),
-               "scan_out": dict(caps.scan_out)}
+               "scan_out": dict(caps.scan_out),
+               "bucket_probe": dict(caps.bucket_probe)}
         changed = False
         for (widx, kind, width), actual in zip(stage_keys, actuals):
             nid = rev.get(widx)
@@ -383,7 +389,8 @@ class Executor:
             return None
         return Capacities(new["repartition"], new["join_out"],
                           new["agg_out"], caps.dense_off,
-                          new["scan_out"], caps.output_repart)
+                          new["scan_out"], caps.output_repart,
+                          new["bucket_probe"])
 
     # ------------------------------------------------------------------
     @staticmethod
@@ -398,7 +405,8 @@ class Executor:
                 {order[k]: v for k, v in caps.agg_out.items()},
                 caps.dense_off,
                 {order[k]: v for k, v in caps.scan_out.items()},
-                caps.output_repart)
+                caps.output_repart,
+                {order[k]: v for k, v in caps.bucket_probe.items()})
 
     @staticmethod
     def _caps_from_order(plan: QueryPlan, memo: tuple) -> Capacities:
@@ -410,7 +418,9 @@ class Executor:
                           {rev[i]: v for i, v in memo[2].items()},
                           memo[3],
                           {rev[i]: v for i, v in memo[4].items()},
-                          memo[5] if len(memo) > 5 else None)
+                          memo[5] if len(memo) > 5 else None,
+                          {rev[i]: v for i, v in memo[6].items()}
+                          if len(memo) > 6 else None)
 
     def _initial_capacities(self, plan: QueryPlan, feeds,
                             dense_off: bool = False) -> Capacities:
@@ -418,11 +428,13 @@ class Executor:
         repart_factor = self.settings.get("repartition_capacity_factor")
         join_factor = self.settings.get("join_output_capacity_factor")
         group_factor = self.settings.get("agg_group_capacity_factor")
+        bucket_factor = self.settings.get("join_probe_bucket_factor")
         n_dev = plan.n_devices
         repart: dict[int, int] = {}
         join_out: dict[int, int] = {}
         agg_out: dict[int, int] = {}
         scan_out: dict[int, int] = {}
+        bucket_probe: dict[int, int] = {}
 
         def cap_of(node, skip_emit: bool = False) -> int:
             """skip_emit: the node's OWN output buffer is never
@@ -473,7 +485,10 @@ class Executor:
                             * max(1.0, node.est_expansion)) + 128)
                     return lcap
                 if skip_emit:
-                    return max(lcap, rcap)  # no emission buffer exists
+                    # aggregate pushdown consumes the join through
+                    # _bounds (no fused lookup, no pair emission): no
+                    # emission OR bucket-probe buffer exists
+                    return max(lcap, rcap)
                 if getattr(node, "fuse_lookup", False) and not dense_off \
                         and node.left_keys:
                     # fused PK lookup: one output slot per probe row; a
@@ -482,6 +497,20 @@ class Executor:
                     # aggregates/joins size by the join estimate
                     out = (rcap if node.join_type == "inner"
                            and node.build_side == "left" else lcap)
+                    if getattr(node, "probe_bucketed", False):
+                        # bucketed probe: per-bucket slots at the
+                        # uniform-hash expectation × skew headroom;
+                        # a hot bucket overflows and regrows through
+                        # the normal retry path, feedback tightens
+                        ext = (node.left_key_extents
+                               if node.build_side == "left"
+                               else node.right_key_extents)
+                        if ext and ext[0] is not None:
+                            from ..ops.join import probe_bucket_count
+
+                            nb = probe_bucket_count(int(ext[0][1]))
+                            bucket_probe[id(node)] = _round_cap(
+                                int(out / nb * bucket_factor))
                     if node.join_type == "inner" and node.residual is None:
                         est = max(1, node.est_rows)
                         k = _round_cap(int(-(-est // n_dev) * 1.5) + 512)
@@ -559,7 +588,7 @@ class Executor:
             out_rp = _round_cap(
                 int(-(-root_cap // n_dev) * repart_factor) + 256)
         return Capacities(repart, join_out, agg_out, dense_off, scan_out,
-                          out_rp)
+                          out_rp, bucket_probe)
 
     # ------------------------------------------------------------------
     def _host_combine(self, plan: QueryPlan, cols, nulls, valid,
@@ -698,6 +727,20 @@ def _plan_buffer_bytes(plan: QueryPlan, caps: Capacities) -> int:
             ncols = len(node.out_columns) if node is not None else 4
             worst = max(worst,
                         cap * factor * (ncols + 2) * 8 * plan.n_devices)
+    for nid, cap in caps.bucket_probe.items():
+        # bucketed-probe pack: [n_buckets, cap] int32 × (local, pos,
+        # gathered output) per device.  A hot-bucket overflow retry
+        # regrows the PER-BUCKET cap, so this is the buffer that can
+        # explode under skew — it must be visible to the guard.
+        node = nodes.get(nid)
+        ext = (() if node is None else
+               (node.left_key_extents if node.build_side == "left"
+                else node.right_key_extents))
+        if ext and ext[0] is not None:
+            from ..ops.join import probe_bucket_count
+
+            nb = probe_bucket_count(int(ext[0][1]))
+            worst = max(worst, cap * nb * 3 * 4 * plan.n_devices)
     return worst
 
 

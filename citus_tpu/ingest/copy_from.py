@@ -85,6 +85,19 @@ def copy_from(session, stmt: ast.CopyFrom):
     finally:
         stop.set()  # a mid-parse producer stops at its next put attempt
         t.join(timeout=10.0)
+        if t.is_alive():
+            # the producer only checks `stop` between put attempts, so a
+            # parse wedged inside one batch (e.g. a blocking read on a
+            # pipe) outlives the statement as a daemon thread still
+            # holding the input file — say so instead of returning (or
+            # propagating the consumer's error) silently
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "COPY producer thread for %r still parsing 10 s after "
+                "consumer shutdown; abandoning it as a daemon thread "
+                "(input file handle stays open until it exits)",
+                stmt.path)
     return ResultSet(["copied"], {"copied": [total]}, 1)
 
 

@@ -45,6 +45,34 @@ import jax.numpy as jnp
 # the build side (sparse 64-bit keys fall back to binary search)
 DENSE_MAX_SLOTS = 1 << 26
 
+# bucketed probe path: directory slots per bucket tile.  An int32 tile of
+# 2^15 slots is 128 KB — VMEM-resident with pipelining headroom on a
+# 16 MB/core budget, and small enough that a probe stream sorted by
+# bucket turns the random directory gather into sequential tile traffic.
+PROBE_TILE_SLOTS = 1 << 15
+# below this extent the whole directory is cache-sized and the single
+# random gather is already bandwidth-friendly; the bucketed path's
+# pack (one int32 argsort over the probe side) would cost more than the
+# locality it buys.  Threshold = the measured knee where dense_unique_
+# lookup's probe throughput collapses (~16 MB of directory, PERF_NOTES
+# round-5 table: random gathers over 60M entries run ~300× below
+# roofline while small directories ride the caches).
+PROBE_BUCKET_MIN_EXTENT = 1 << 22
+
+
+def probe_bucket_count(extent: int) -> int:
+    """Number of VMEM-sized directory tiles covering [0, extent)."""
+    return max(1, -(-extent // PROBE_TILE_SLOTS))
+
+
+def probe_bucket_eligible(extent: int, probe_rows: int) -> bool:
+    """Planner cost threshold for the bucketed probe path: the directory
+    must be past the cache knee AND the probe stream must be dense enough
+    to amortize streaming every tile once (a sparse probe over a huge
+    directory still favors the single gather — most tiles would stream
+    in for a handful of probes)."""
+    return extent >= PROBE_BUCKET_MIN_EXTENT and probe_rows * 4 >= extent
+
 
 def dense_directory_ok(extent: int, build_size: int) -> bool:
     """Shared eligibility predicate for the dense probe directory
@@ -248,6 +276,108 @@ def dense_unique_lookup(build_key: jnp.ndarray,
     bidx = jnp.minimum(raw, m - 1)
     counts = found.astype(jnp.int32)
     return bidx, counts, oob + dup
+
+
+def bucketed_unique_lookup(build_key: jnp.ndarray,
+                           build_matchable: jnp.ndarray,
+                           probe_key: jnp.ndarray, base: int, extent: int,
+                           bucket_cap: int, kernel: str = "xla",
+                           interpret: bool = False,
+                           ) -> tuple[jnp.ndarray, jnp.ndarray,
+                                      jnp.ndarray, jnp.ndarray,
+                                      jnp.ndarray]:
+    """Hash-bucketed, VMEM-tiled variant of dense_unique_lookup.
+
+    The single-gather probe is latency-bound: random HBM touches over a
+    multi-hundred-MB directory run ~300× below the memory roofline
+    (~80M probes/s measured on v5e at SF10 sizes — PERF_NOTES).  This
+    path restores locality the radix-join way (Theseus, arXiv
+    2508.05029; shared-nothing multicore joins, arXiv 1804.09324;
+    reference repartition machinery, multi_physical_planner.c
+    BuildMapMergeJob): partition the probe stream by directory tile
+    until each tile fits fast memory, then probe tile-by-tile so the
+    directory streams through VMEM exactly once.
+
+      1. build the dense directory as usual (one scatter; duplicate
+         build keys detected build-side exactly like dense_unique_lookup
+         so the stale-uniqueness retry contract cannot diverge),
+      2. pack probe rows by bucket = slot // PROBE_TILE_SLOTS with the
+         same counting-sort gather the repartition shuffle uses
+         (pack_by_target) into a [n_buckets, bucket_cap] buffer,
+      3. probe bucket-by-bucket — each bucket's tile is VMEM-sized and
+         its probes are contiguous (kernel='xla': a batched row-local
+         take_along_axis; kernel='pallas': the tile-resident kernel in
+         ops/pallas_kernels.py),
+      4. scatter hits back to original probe positions (unique-index).
+
+    Returns (bidx [N], counts [N], oob_count, bucket_overflow,
+    bucket_max_fill): oob_count follows the dense_unique_lookup contract
+    (out-of-range + duplicate build rows → the host retries on the
+    general path); bucket_overflow counts probe rows dropped because
+    their bucket exceeded bucket_cap — results are incomplete and the
+    host retries with grown per-bucket capacity (the same
+    count-then-emit protocol every static buffer uses).  bucket_max_fill
+    is the realized per-bucket maximum (capacity-feedback input)."""
+    tile = PROBE_TILE_SLOTS
+    m = build_key.shape[0]
+    n = probe_key.shape[0]
+    n_buckets = max(1, -(-extent // tile))
+    ext_pad = n_buckets * tile
+
+    # directory build + duplicate detection: identical accounting to
+    # dense_unique_lookup (padding slots [extent, ext_pad) stay empty)
+    idx = build_key.astype(jnp.int64) - jnp.int64(base)
+    inb = build_matchable & (idx >= 0) & (idx < extent)
+    oob = (build_matchable & ~inb).sum().astype(jnp.int64)
+    slot = jnp.where(inb, idx, ext_pad).astype(jnp.int32)
+    iota_m = jnp.arange(m, dtype=jnp.int32)
+    directory = jnp.full(ext_pad, m, jnp.int32).at[slot].set(
+        iota_m, mode="drop")
+    dup = (inb & (jnp.minimum(directory[jnp.minimum(slot, ext_pad - 1)], m)
+                  != iota_m)).sum().astype(jnp.int64)
+
+    pin, pc = _probe_slots(probe_key, base, extent)
+    from .hashing import tile_buckets
+    from .partition import pack_by_target
+
+    bucket, local = tile_buckets(pc, tile)
+
+    packed, pvalid, overflow = pack_by_target(
+        {"local": local, "pos": jnp.arange(n, dtype=jnp.int32)},
+        pin, bucket, n_buckets, bucket_cap)
+    # realized skew (max bucket fill) feeds capacity tightening; on an
+    # overflowed run the retry regrows before feedback ever fires
+    bucket_max_fill = pvalid.sum(axis=1).max().astype(jnp.int64)
+
+    dir2d = directory.reshape(n_buckets, tile)
+    loc2d = jnp.where(pvalid, packed["local"], 0)
+    if kernel == "pallas" and not interpret:
+        import jax
+
+        from .pallas_kernels import pallas_available
+
+        if not pallas_available() or jax.default_backend() == "cpu":
+            # config asked for the kernel where it cannot compile — a
+            # jax build that can't import pallas, or the CPU backend
+            # (compiled pallas_call is interpret-only there): degrade
+            # to the XLA formulation (same results) rather than crash
+            # mid-compile
+            kernel = "xla"
+    if kernel == "pallas":
+        from .pallas_kernels import bucketed_probe_pallas
+
+        raw2d = bucketed_probe_pallas(dir2d, loc2d, interpret=interpret)
+    else:
+        raw2d = jnp.take_along_axis(dir2d, loc2d, axis=1)
+
+    pos = jnp.where(pvalid, packed["pos"], n).reshape(-1)
+    raw = jnp.full(n, m, jnp.int32).at[pos].set(
+        raw2d.reshape(-1), mode="drop")
+    found = pin & (raw != m)
+    bidx = jnp.minimum(raw, m - 1)
+    counts = found.astype(jnp.int32)
+    return bidx, counts, oob + dup, overflow.astype(jnp.int64), \
+        bucket_max_fill
 
 
 def _bounds(build_keys, build_matchable, probe_keys,

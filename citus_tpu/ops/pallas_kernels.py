@@ -1,4 +1,4 @@
-"""Pallas TPU kernels for the aggregation hot path.
+"""Pallas TPU kernels for the aggregation and join-probe hot paths.
 
 BASELINE.json's north star calls for hand kernels on the hot ops (the
 reference's equivalents are C inner loops: per-tuple hash-aggregate
@@ -41,6 +41,7 @@ except Exception:  # pragma: no cover
 
 ROW_TILE = 1024       # rows per grid step
 K_CHUNK = 512         # one-hot width per MXU feed
+PROBE_CHUNK = 512     # probe rows streamed per step through one tile
 
 
 def _round_up(n: int, m: int) -> int:
@@ -115,6 +116,59 @@ if _PALLAS_OK:
             interpret=interpret,
         )(slot_p, vals_p)
         return out[:total, :a]
+
+
+if _PALLAS_OK:
+
+    def _probe_kernel(tile_ref, loc_ref, out_ref):
+        """One grid step: gather PROBE_CHUNK probes against the resident
+        directory tile.  The tile block's index map ignores the chunk
+        grid dimension, so Pallas keeps it in VMEM across all of a
+        bucket's probe chunks — the directory streams HBM→VMEM exactly
+        once while probe chunks pipeline through it."""
+        out_ref[:] = jnp.take_along_axis(tile_ref[:], loc_ref[:], axis=1)
+
+    @functools.partial(jax.jit, static_argnames=("interpret",))
+    def bucketed_probe_pallas(dir2d: jnp.ndarray, loc2d: jnp.ndarray,
+                              interpret: bool = False) -> jnp.ndarray:
+        """VMEM-tiled directory probe for the bucketed join path.
+
+        dir2d [n_buckets, tile] int32 — directory values per bucket tile
+        (tile is VMEM-sized, ops.join.PROBE_TILE_SLOTS by default);
+        loc2d [n_buckets, cap] int32 — tile-local probe slots, packed by
+        bucket (garbage lanes must hold a clipped in-range slot).
+        Returns [n_buckets, cap] int32 gathered directory values.
+
+        Grid = (bucket, probe chunk); the in-kernel gather is a 2D
+        lane-dimension take_along_axis, the shape Mosaic lowers as a
+        vector dynamic-gather.  Whether this beats the plain-XLA batched
+        gather on real hardware is bench_kernels.bench_probe()'s call —
+        the executor routes through XLA unless the measurement says
+        otherwise (same contract as the aggregation kernel above)."""
+        k, tile = dir2d.shape
+        _, cap = loc2d.shape
+        cap_pad = _round_up(max(cap, PROBE_CHUNK), PROBE_CHUNK)
+        if cap_pad != cap:
+            loc2d = jnp.zeros((k, cap_pad), jnp.int32).at[:, :cap].set(
+                loc2d)
+        out = pl.pallas_call(
+            _probe_kernel,
+            grid=(k, cap_pad // PROBE_CHUNK),
+            in_specs=[
+                pl.BlockSpec((1, tile), lambda i, j: (i, 0)),
+                pl.BlockSpec((1, PROBE_CHUNK), lambda i, j: (i, j)),
+            ],
+            out_specs=pl.BlockSpec((1, PROBE_CHUNK), lambda i, j: (i, j)),
+            out_shape=jax.ShapeDtypeStruct((k, cap_pad), jnp.int32),
+            interpret=interpret,
+        )(dir2d, loc2d)
+        return out[:, :cap]
+
+
+def probe_gather_reference(dir2d: np.ndarray,
+                           loc2d: np.ndarray) -> np.ndarray:
+    """numpy oracle for the tiled probe gather."""
+    return np.take_along_axis(np.asarray(dir2d), np.asarray(loc2d), axis=1)
 
 
 def segment_sum_reference(slot: np.ndarray, values: np.ndarray,

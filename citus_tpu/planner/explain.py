@@ -112,10 +112,12 @@ def _format_node(node: PlanNode, lines: list[str], depth: int,
         dense = (bool(ext) and ext[0] is not None
                  and len(node.left_keys) == 1
                  and dense_directory_ok(ext[0][1], build.est_rows))
+        bucketed = dense and node.fuse_lookup and node.probe_bucketed
         lines.append(f"{pad}-> {label} on ({conds})  "
                      f"[build: {node.build_side}"
                      f"{', dense directory' if dense else ''}"
-                     f"{', fused lookup' if node.fuse_lookup else ''}]")
+                     f"{', fused lookup' if node.fuse_lookup else ''}"
+                     f"{', bucketed probe' if bucketed else ''}]")
         if node.residual is not None:
             lines.append(f"{pad}     Residual: {node.residual}")
         _format_node(node.left, lines, depth + 1, catalog,

@@ -33,8 +33,22 @@ def codec_id(name: str) -> int:
     if name not in _NAME_TO_ID:
         raise StorageError(f"unknown compression codec {name!r}")
     if name == "zstd" and not _HAVE_ZSTD:
-        raise StorageError("zstd codec unavailable (zstandard not installed)")
+        # degrade WRITES to zlib on hosts without the binding (stripes
+        # record their codec id, so files stay self-describing and
+        # readable anywhere); reads of existing zstd stripes still
+        # raise — silently wrong bytes are never an option
+        global _warned_no_zstd
+        if not _warned_no_zstd:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "zstandard not installed; writing zlib stripes instead")
+            _warned_no_zstd = True
+        return CODEC_ZLIB
     return _NAME_TO_ID[name]
+
+
+_warned_no_zstd = False
 
 
 def codec_name(cid: int) -> str:

@@ -115,7 +115,11 @@ def lookup(store, table: str, shard_id: int, column: str,
                                       records)
             try:
                 os.makedirs(os.path.dirname(path), exist_ok=True)
-                tmp = path + ".tmp.npz"
+                # per-writer tmp name: two sessions rebuilding the same
+                # stale index concurrently must not interleave writes
+                # into ONE tmp file and os.replace a torn npz — each
+                # writer publishes its own complete file atomically
+                tmp = f"{path}.tmp.{os.getpid()}.npz"
                 files = np.asarray([f for f, _r in sig])
                 rows = np.asarray([r for _f, r in sig], dtype=np.int64)
                 np.savez(tmp, keys=keys, stripe_idx=sidx, row_pos=rpos,

@@ -484,3 +484,114 @@ def test_mixed_count_and_distinct_over_empty_input(sess):
     r = sess.execute(
         "select count(a), count(distinct a) from ce").rows()[0]
     assert r == (2, 2), r
+
+
+def _force_bucketed_lookup(plan, build_table, base, extent):
+    """Flip every join in `plan` onto the fused bucketed-probe path with
+    `build_table` as the (claimed-unique) build side."""
+    from citus_tpu.executor.feed import walk_plan
+    from citus_tpu.planner.plan import JoinNode, ScanNode
+
+    for node in walk_plan(plan.root):
+        if isinstance(node, JoinNode):
+            left_is_build = isinstance(node.left, ScanNode) and \
+                node.left.rel.table == build_table
+            node.fuse_lookup = True
+            node.probe_bucketed = True
+            node.build_side = "left" if left_is_build else "right"
+            node.left_key_extents = ((base, extent),)
+            node.right_key_extents = ((base, extent),)
+
+
+def test_bucketed_probe_join_matches_oracle(sess, monkeypatch):
+    """The VMEM-tiled bucketed probe path must return exactly what the
+    single-gather path returns — pinned end-to-end on the CPU mesh with
+    the tile patched small so the 200-slot directory spans 13 buckets."""
+    import citus_tpu.ops.join as J
+    from citus_tpu.sql.parser import parse_one
+
+    monkeypatch.setattr(J, "PROBE_TILE_SLOTS", 16)
+    calls = []
+    orig = J.bucketed_unique_lookup
+
+    def spy(*a, **kw):
+        calls.append(1)
+        return orig(*a, **kw)
+
+    monkeypatch.setattr(J, "bucketed_unique_lookup", spy)
+
+    sess.execute("create table bua (k bigint, v int)")
+    sess.create_distributed_table("bua", "k", shard_count=4)
+    sess.execute("create table bub (k bigint, w int)")
+    sess.create_distributed_table("bub", "k", shard_count=4)
+    sess.execute("insert into bua values " + ",".join(
+        f"({k},{k * 10})" for k in range(1, 201)))
+    # probes: two rows per key over a wider range, so some keys miss
+    # the directory entirely and some buckets stay empty
+    sess.execute("insert into bub values " + ",".join(
+        f"({i % 250 + 1},{i})" for i in range(400)))
+    plan, _cleanup = sess._plan_select(parse_one(
+        "select v, w from bua, bub where bua.k = bub.k"))
+    _force_bucketed_lookup(plan, "bua", base=1, extent=200)
+    result = sess.executor.execute_plan(plan)
+    assert calls, "bucketed probe path was never traced"
+    assert result.retries == 0  # clean first execution, no overflow
+    expect = sorted(((i % 250 + 1) * 10, i) for i in range(400)
+                    if i % 250 + 1 <= 200)
+    assert sorted(tuple(r) for r in result.rows()) == expect
+
+
+def test_bucketed_probe_duplicate_build_keys_fallback(sess, monkeypatch):
+    """Stale uniqueness under the bucketed probe: duplicate build keys
+    must surface dense_oob and retry on the general expansion path,
+    exactly like dense_unique_lookup — never an arbitrary single match."""
+    import citus_tpu.ops.join as J
+    from citus_tpu.sql.parser import parse_one
+
+    monkeypatch.setattr(J, "PROBE_TILE_SLOTS", 16)
+    sess.execute("create table dua (k bigint, v int)")
+    sess.create_distributed_table("dua", "k", shard_count=4)
+    sess.execute("create table dub (k bigint, w int)")
+    sess.create_distributed_table("dub", "k", shard_count=4)
+    sess.execute("insert into dua values (1,10),(2,20),(3,30)")
+    # build side duplicates k=2: the correct result needs BOTH matches
+    sess.execute("insert into dub values (1,1),(2,2),(2,5),(3,3)")
+    plan, _cleanup = sess._plan_select(parse_one(
+        "select v, w from dua, dub where dua.k = dub.k"))
+    _force_bucketed_lookup(plan, "dub", base=1, extent=3)
+    result = sess.executor.execute_plan(plan)
+    assert result.retries >= 1
+    assert sorted(tuple(r) for r in result.rows()) == \
+        [(10, 1), (20, 2), (20, 5), (30, 3)]
+
+
+def test_bucketed_probe_skew_overflow_regrows(sess, monkeypatch):
+    """A hot bucket (every probe hits one key) overflows its per-bucket
+    capacity; the count-then-emit contract must regrow and retry — rows
+    must never be silently dropped.  (A row-returning join: GLOBAL
+    aggregates take the join-agg pushdown, which probes via _bounds and
+    never fuses lookups.)"""
+    import citus_tpu.ops.join as J
+    from citus_tpu.sql.parser import parse_one
+
+    monkeypatch.setattr(J, "PROBE_TILE_SLOTS", 16)
+    sess.execute("set join_probe_bucket_factor = 1.0")
+    sess.execute("create table sua (k bigint, v int)")
+    sess.create_distributed_table("sua", "k", shard_count=4)
+    sess.execute("create table sub_ (k bigint, w int)")
+    sess.create_distributed_table("sub_", "k", shard_count=4)
+    sess.execute("insert into sua values " + ",".join(
+        f"({k},{k * 10})" for k in range(1, 65)))
+    # 600 probes of k=5 — all in ONE bucket on ONE device — plus a thin
+    # uniform spread so other buckets are nonempty
+    rows = [f"(5,{i})" for i in range(600)]
+    rows += [f"({i % 64 + 1},{1000 + i})" for i in range(64)]
+    sess.execute("insert into sub_ values " + ",".join(rows))
+    plan, _cleanup = sess._plan_select(parse_one(
+        "select v, w from sua, sub_ where sua.k = sub_.k"))
+    _force_bucketed_lookup(plan, "sua", base=1, extent=64)
+    result = sess.executor.execute_plan(plan)
+    assert result.retries >= 1  # the hot bucket overflowed and regrew
+    expect = sorted([(50, i) for i in range(600)] +
+                    [((i % 64 + 1) * 10, 1000 + i) for i in range(64)])
+    assert sorted(tuple(r) for r in result.rows()) == expect
