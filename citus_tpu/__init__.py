@@ -24,6 +24,8 @@ from .errors import (
     IngestError,
     ParseError,
     PlanningError,
+    QueryCanceled,
+    StatementTimeout,
     StorageError,
     TransactionError,
     UnsupportedQueryError,
@@ -37,7 +39,8 @@ __all__ = [
     "sql_type_to_datatype", "CitusTpuError", "ConfigError", "CatalogError",
     "StorageError", "ParseError", "PlanningError", "UnsupportedQueryError",
     "ExecutionError", "CapacityOverflowError", "IngestError",
-    "TransactionError", "__version__",
+    "TransactionError", "QueryCanceled", "StatementTimeout",
+    "__version__",
 ]
 
 

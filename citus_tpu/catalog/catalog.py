@@ -134,6 +134,11 @@ class TableMetadata:
             o["colocation_id"], ReplicationModel(o.get("replication_model", "2pc")))
 
 
+# first shard/placement id of the reserved in-memory temp-table range
+# (persisted allocations grow from ~102008 and can never reach this)
+TEMP_ID_BASE = 1 << 40
+
+
 class Catalog:
     """In-memory catalog with JSON persistence and a version counter.
 
@@ -159,10 +164,23 @@ class Catalog:
         self.views: dict[str, dict] = {}
         self.version = 0
         self._disk_stat = None  # (mtime_ns, size) of the persisted file
+        # placements the statement retry loop observed failing a shard
+        # read: active_placement prefers non-suspect replicas so the
+        # retry lands elsewhere (in-memory, this process only — the
+        # adaptive-executor transient-failure mark, not a catalog fact)
+        self._suspect_placements: set[int] = set()
         self._next_shard_id = 102008   # reference shard ids start ~102008
         self._next_placement_id = 1
         self._next_node_id = 1
         self._next_colocation_id = 1
+        # session-private temp tables (__intermediate_*) allocate shard/
+        # placement ids from a reserved high range persisted catalogs can
+        # never reach: maybe_reload merges live temps over a fresh disk
+        # catalog, and a colliding id would silently clobber another
+        # session's committed shard (the ids are in-memory only — temps
+        # are never persisted)
+        self._next_temp_shard_id = TEMP_ID_BASE
+        self._next_temp_placement_id = TEMP_ID_BASE
 
     # -- mutation helpers --------------------------------------------------
     def _bump(self):
@@ -319,11 +337,14 @@ class Catalog:
             self._bump()
 
     def activate_node(self, name: str) -> None:
-        """citus_activate_node analogue."""
+        """citus_activate_node analogue.  Reactivation also clears the
+        node's placements from the retry loop's suspect set — an
+        operator bringing a node back is declaring it healthy."""
         with self._lock:
             node = self.node_by_name(name)
             node.is_active = True
             self._bump()
+        self.clear_placement_suspects(node.node_id)
 
     def node_by_name(self, name: str) -> NodeMetadata:
         for n in self.nodes.values():
@@ -424,7 +445,13 @@ class Catalog:
         whose NODE is alive.  With replicated placements this IS the
         read failover — disabling a node silently shifts every affected
         shard to its next replica (the reference interleaves failover
-        into task execution instead, adaptive_executor.c:95-116)."""
+        into task execution instead, adaptive_executor.c:95-116).
+        Placements the retry loop marked suspect are deprioritized, not
+        excluded: when every replica is suspect the first live one still
+        answers (a wrong routing beats an unroutable shard)."""
+        from ..utils.faultinjection import fault_point
+
+        fault_point("catalog.placement_probe")
         ps = self.shard_placements(shard_id)
         live = [p for p in ps
                 if (n := self.nodes.get(p.node_id)) is not None
@@ -432,7 +459,42 @@ class Catalog:
         if not live:
             raise CatalogError(
                 f"shard {shard_id} has no active placement on a live node")
+        if self._suspect_placements:
+            trusted = [p for p in live
+                       if p.placement_id not in self._suspect_placements]
+            if trusted:
+                return trusted[0]
         return live[0]
+
+    def mark_placement_suspect(self, placement_id: int) -> bool:
+        """Record a shard-read failure against a placement so the next
+        `active_placement` pick routes around it.  Returns True only
+        when the shard has a live, NOT-already-suspect replica to fail
+        over to — i.e. when marking actually changes the retry's
+        routing (the caller counts that as a failover; re-marking a
+        placement with every replica already suspect is a bare retry)."""
+        with self._lock:
+            self._suspect_placements.add(placement_id)
+            p = self.placements.get(placement_id)
+        if p is None:
+            return False
+        others = [q for q in self.shard_placements(p.shard_id)
+                  if q.placement_id != placement_id
+                  and q.placement_id not in self._suspect_placements
+                  and (n := self.nodes.get(q.node_id)) is not None
+                  and n.is_active]
+        return bool(others)
+
+    def clear_placement_suspects(self, node_id: int | None = None) -> None:
+        """Forget suspicion (all placements, or one recovered node's)."""
+        with self._lock:
+            if node_id is None:
+                self._suspect_placements.clear()
+                return
+            self._suspect_placements = {
+                pid for pid in self._suspect_placements
+                if (p := self.placements.get(pid)) is not None
+                and p.node_id != node_id}
 
     def colocated_tables(self, name: str) -> list[str]:
         t = self.table(name)
@@ -503,11 +565,21 @@ class Catalog:
             group = self.get_or_create_colocation_group(1, None)
             meta = TableMetadata(name, schema, DistributionMethod.REFERENCE,
                                  None, group.colocation_id)
-            sid = self.allocate_shard_id()
+            temp = name.startswith("__intermediate_")
+            if temp:
+                sid = self._next_temp_shard_id
+                self._next_temp_shard_id += 1
+            else:
+                sid = self.allocate_shard_id()
             shard = ShardInterval(sid, name, 0, None, None)
-            placements = [ShardPlacement(self.allocate_placement_id(), sid,
-                                         n.node_id)
-                          for n in self.active_nodes()]
+            placements = []
+            for n in self.active_nodes():
+                if temp:
+                    pid = self._next_temp_placement_id
+                    self._next_temp_placement_id += 1
+                else:
+                    pid = self.allocate_placement_id()
+                placements.append(ShardPlacement(pid, sid, n.node_id))
             self.register_table(meta, [shard], placements)
             return meta
 
@@ -607,6 +679,21 @@ class Catalog:
             if getattr(self, "_disk_stat", None) == disk:
                 return False
             fresh = Catalog.load(path)
+            # merge, don't replace: this session's in-memory temp
+            # reference tables (__intermediate_* — recursive-planning
+            # materializations, never persisted) may be live MID-
+            # STATEMENT; a wholesale swap would drop them and the outer
+            # query's scan of its own CTE would fail (ADVICE r5).
+            temps = {n: m for n, m in self.tables.items()
+                     if n.startswith("__intermediate_")
+                     and n not in fresh.tables}
+            temp_shards = {sid: s for sid, s in self.shards.items()
+                           if s.table_name in temps}
+            temp_pids = {pid: p for pid, p in self.placements.items()
+                         if p.shard_id in temp_shards}
+            temp_colo = {m.colocation_id: self.colocation_groups[
+                m.colocation_id] for m in temps.values()
+                if m.colocation_id in self.colocation_groups}
             self.tables = fresh.tables
             self.shards = fresh.shards
             self.placements = fresh.placements
@@ -614,10 +701,21 @@ class Catalog:
             self.colocation_groups = fresh.colocation_groups
             self.sequences = fresh.sequences
             self.views = fresh.views
-            self._next_shard_id = fresh._next_shard_id
-            self._next_placement_id = fresh._next_placement_id
-            self._next_node_id = fresh._next_node_id
-            self._next_colocation_id = fresh._next_colocation_id
+            self.tables.update(temps)
+            self.shards.update(temp_shards)
+            self.placements.update(temp_pids)
+            for cid, grp in temp_colo.items():
+                self.colocation_groups.setdefault(cid, grp)
+            # id counters never move backwards: the disk catalog may be
+            # older than ids our live temps already hold
+            self._next_shard_id = max(fresh._next_shard_id,
+                                      self._next_shard_id)
+            self._next_placement_id = max(fresh._next_placement_id,
+                                          self._next_placement_id)
+            self._next_node_id = max(fresh._next_node_id,
+                                     self._next_node_id)
+            self._next_colocation_id = max(fresh._next_colocation_id,
+                                           self._next_colocation_id)
             self._disk_stat = fresh._disk_stat
             self._bump()
             return True

@@ -132,7 +132,20 @@ class ChangeLog:
         return ev
 
     def emit(self, events: list[dict]) -> None:
-        """Append a commit's worth of events: one write + fsync."""
+        """Append a commit's worth of events: one write + fsync.
+
+        emit() runs AFTER the manifest flip made the commit visible, so
+        any failure here (injected or a real OSError on the journal) is
+        post-visibility: re-executing the statement would double-apply.
+        Escaping exceptions are tagged so the statement retry loop's
+        classifier refuses them."""
+        try:
+            self._emit(events)
+        except BaseException as e:
+            e.post_visibility = True
+            raise
+
+    def _emit(self, events: list[dict]) -> None:
         if not self.enabled or self.suppressed or not events:
             return
         from ..utils.faultinjection import fault_point

@@ -150,6 +150,34 @@ _register(ConfigVar(
     "allocator OOM). 0 disables the guard.",
     int, min_value=0, max_value=1 << 44))
 
+# --- resilience -----------------------------------------------------------
+_register(ConfigVar(
+    "max_statement_retries", 2,
+    "Bounded per-statement retry loop for transient failures (injected "
+    "faults, storage IO): classify, mark the failing placement suspect, "
+    "run 2PC recovery, back off, re-execute (the adaptive executor's "
+    "task retry onto replica placements, adaptive_executor.c:95-116). "
+    "0 disables.",
+    int, min_value=0, max_value=32))
+_register(ConfigVar(
+    "retry_backoff_base_ms", 5.0,
+    "First retry backoff; doubles per attempt with ±50% jitter "
+    "(decorrelated-jitter analogue of the reference's connection "
+    "retry pacing).",
+    float, min_value=0.0, max_value=60_000.0))
+_register(ConfigVar(
+    "retry_backoff_max_ms", 200.0,
+    "Backoff ceiling for the statement retry loop.",
+    float, min_value=0.0, max_value=600_000.0))
+_register(ConfigVar(
+    "statement_timeout_ms", 0,
+    "Cooperative per-statement deadline, checked at fault points, "
+    "stream/COPY batch boundaries and retry iterations; raises "
+    "StatementTimeout (PostgreSQL statement_timeout analogue; the "
+    "reference additionally enforces citus.node_connection_timeout "
+    "per worker connection). 0 disables.",
+    int, min_value=0, max_value=86_400_000))
+
 # --- columnar storage (ref: columnar GUCs + columnar.options catalog) -----
 _register(ConfigVar(
     "columnar_stripe_row_limit", 150_000,

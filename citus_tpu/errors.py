@@ -23,7 +23,15 @@ class CatalogError(CitusTpuError):
 
 
 class StorageError(CitusTpuError):
-    """Columnar storage format or IO error."""
+    """Columnar storage format or IO error.
+
+    When raised from a shard read, carries `table`/`shard_id` attributes
+    so the statement retry loop can mark the failing placement suspect
+    and re-derive routing onto a surviving replica (the adaptive-executor
+    placement-failover analogue, adaptive_executor.c:95-116)."""
+
+    table: str | None = None
+    shard_id: int | None = None
 
 
 class ParseError(CitusTpuError):
@@ -48,6 +56,19 @@ class PlanningError(CitusTpuError):
 
 class UnsupportedQueryError(PlanningError):
     """Query shape recognized but not supported by any planner stage."""
+
+
+class QueryCanceled(CitusTpuError):
+    """Statement canceled cooperatively (the pg_cancel_backend analogue):
+    Session.cancel() sets a flag the executing thread notices at the next
+    seam — fault point, stream/COPY batch boundary, retry iteration."""
+
+
+class StatementTimeout(QueryCanceled):
+    """`statement_timeout_ms` deadline passed (PostgreSQL
+    statement_timeout analogue; the reference enforces
+    citus.node_connection_timeout per connection — here the whole
+    statement carries one cooperative deadline)."""
 
 
 class ExecutionError(CitusTpuError):

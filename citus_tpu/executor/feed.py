@@ -273,6 +273,12 @@ def _feed_scan(node: ScanNode, catalog: Catalog, store: TableStore,
                         valid=valid, capacity=cap)
 
     # place on the mesh
+    from ..utils.faultinjection import fault_point
+
+    # named seam: a host→HBM transfer failure (device OOM, remote-
+    # attached link drop) must surface as a retryable statement error,
+    # never a partially placed feed
+    fault_point("executor.device_put")
     put = put_sharded if feed.sharded else put_replicated
     feed.arrays = {c: put(mesh, a) for c, a in feed.arrays.items()}
     feed.nulls = {c: put(mesh, a) for c, a in feed.nulls.items()}

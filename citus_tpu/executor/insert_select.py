@@ -289,6 +289,12 @@ def _write_result(session, meta, columns, result, mode="repartition",
 
 def _route_and_write(session, meta, columns, typed, validity, result,
                      mode, device_routed) -> int:
+    from ..utils.faultinjection import fault_point
+
+    # named seam: a failure while shuffling INSERT..SELECT rows to their
+    # target shards must leak no invisible stripes (the discard_pending
+    # cleanup below is the recovery path under test)
+    fault_point("executor.repartition_shuffle")
     n = result.row_count
     codec = session.settings.get("columnar_compression")
     level = session.settings.get("columnar_compression_level")

@@ -176,10 +176,13 @@ class Executor:
         selectivities over correlated columns are statically
         unestimable).  An over-tightened buffer (data changed) simply
         overflows and regrows through the normal retry path."""
+        from ..utils.cancellation import check_cancel
+
         limit = self.settings.get("max_plan_buffer_bytes")
         retries = 0
         tightened = False
         while True:
+            check_cancel()  # overflow-retry iterations are cancel seams
             if limit:
                 est = _plan_buffer_bytes(plan, caps)
                 if est > limit:
@@ -193,6 +196,11 @@ class Executor:
             key = fingerprint + (caps_signature(plan, caps), probe_kernel)
             entry = self.plan_cache.get(key)
             if entry is None:
+                from ..utils.faultinjection import fault_point
+
+                # named seam: a failure while tracing/compiling must
+                # leave the plan cache without a half-built entry
+                fault_point("executor.plan_cache_fill")
                 compiler = PlanCompiler(plan, self.mesh, feeds, caps,
                                         compute_dtype,
                                         probe_kernel=probe_kernel)

@@ -491,9 +491,20 @@ def try_execute_streamed(executor, plan: QueryPlan, raw: bool):
     agg_root = (plan.root if isinstance(plan.root, AggregateNode)
                 else None)
     n_consumed = 0
+    from ..utils.cancellation import check_cancel
+
     try:
         while True:
-            kind, payload = fetched.get()
+            # batch boundaries are the streaming path's cancellation
+            # seams: a statement_timeout_ms deadline or Session.cancel()
+            # stops between batches (the finally below unwinds the
+            # prefetch thread cleanly).  The bounded get keeps the
+            # deadline live even when the producer is wedged.
+            check_cancel()
+            try:
+                kind, payload = fetched.get(timeout=0.25)
+            except queue.Empty:
+                continue
             if kind == "err":
                 raise payload
             if kind == "done":

@@ -35,8 +35,11 @@ def copy_from(session, stmt: ast.CopyFrom):
     batches = iter_text_batches(stmt.path, delimiter, stmt.header,
                                 stmt.null_string, len(columns),
                                 batch_rows)
+    from ..utils.cancellation import check_cancel
+
     if not session.settings.get("copy_pipeline"):
         for batch in batches:
+            check_cancel()  # COPY batch boundaries are cancel seams
             total += _ingest_batch(session, stmt.table, columns, batch)[0]
         return ResultSet(["copied"], {"copied": [total]}, 1)
 
@@ -75,7 +78,11 @@ def copy_from(session, stmt: ast.CopyFrom):
     t.start()
     try:
         while True:
-            kind, payload = q.get()
+            check_cancel()  # COPY batch boundaries are cancel seams
+            try:
+                kind, payload = q.get(timeout=0.25)
+            except queue.Empty:
+                continue
             if kind == "err":
                 raise payload
             if kind == "done":

@@ -131,6 +131,10 @@ class Session:
         import threading
 
         self._temp_counter = itertools.count(1)
+        # cooperative cross-thread cancel flag (pg_cancel_backend
+        # analogue): Session.cancel() sets it; the executing thread
+        # notices at the next seam and raises QueryCanceled
+        self._cancel_evt = threading.Event()
         # PREPARE registry: name → statement AST (session-scoped, like PG)
         self._prepared: dict[str, ast.Statement] = {}
         # EXECUTE args visible to recursive planning (subqueries run
@@ -183,10 +187,12 @@ class Session:
         if self.txn_manager.current is None:
             self.catalog.maybe_reload(
                 os.path.join(self.data_dir, "catalog.json"))
-        with self.stats.activity.track(sql):
+        self._cancel_evt.clear()  # a fresh script clears stale cancels
+        with self.stats.activity.track(sql) as activity:
             t0 = _time.perf_counter()
             for stmt in parse(sql):
-                result = self._execute_statement(stmt)
+                activity.retries = 0
+                result = self._execute_resilient(stmt, activity)
                 self._count_statement(stmt, result)
                 tenant_hits.extend(extract_tenants(stmt, self.catalog))
             elapsed_ms = (_time.perf_counter() - t0) * 1000.0
@@ -220,6 +226,178 @@ class Session:
             c.increment(sc.DML_MERGE)
         elif isinstance(stmt, (ast.CreateTable, ast.DropTable)):
             c.increment(sc.DDL_COMMANDS)
+
+    # -- resilient statement execution -------------------------------------
+    # fault points that fire AFTER a write's visibility flip: the effect
+    # is already committed, so re-executing the statement would apply it
+    # twice — the error propagates instead (the reference likewise never
+    # retries a task once its placement reported success)
+    _NON_RETRYABLE_POINTS = frozenset({"cdc.append"})
+
+    def cancel(self) -> None:
+        """Cooperative cross-thread cancel of in-flight statements (the
+        pg_cancel_backend analogue): executing threads notice at their
+        next seam — fault point, stream/COPY batch boundary, retry
+        iteration — and raise QueryCanceled."""
+        self._cancel_evt.set()
+
+    def _execute_resilient(self, stmt: ast.Statement, activity=None):
+        """One statement under the resilience envelope: a cooperative
+        deadline (`statement_timeout_ms` + Session.cancel) around a
+        bounded retry loop (`max_statement_retries`, exponential backoff
+        with jitter) that classifies errors, marks failing placements
+        suspect so the retry's routing fails over to surviving replicas,
+        and runs 2PC recovery first so no retry observes half-applied
+        state — the adaptive executor's task-retry/failover loop
+        (adaptive_executor.c:95-116) hoisted to the statement level."""
+        import random as _random
+        import time as _time
+
+        from .errors import QueryCanceled, StatementTimeout
+        from .stats import counters as sc
+        from .utils.cancellation import check_cancel, deadline_scope
+
+        max_retries = self.settings.get("max_statement_retries")
+        timeout_ms = self.settings.get("statement_timeout_ms")
+        attempt = 0
+        with deadline_scope(timeout_ms or None,
+                            self._cancel_evt) as deadline:
+            while True:
+                # a COMMIT that dies mid-2PC is resolved through
+                # recovery, never re-execution — remember its txid now
+                # (the manager clears `current` on the way out)
+                commit_txid = None
+                if isinstance(stmt, ast.TransactionStmt) and \
+                        stmt.kind == "commit" and \
+                        self.txn_manager.current is not None:
+                    commit_txid = self.txn_manager.current.txid
+                try:
+                    check_cancel()
+                    return self._execute_statement(stmt)
+                except (StatementTimeout, QueryCanceled) as e:
+                    if commit_txid is not None and \
+                            self._resolve_failed_commit(commit_txid):
+                        # the deadline/cancel fired inside the 2PC with
+                        # the commit record already durable: the txn IS
+                        # committed (recovery just rolled it forward) —
+                        # report success, not a lying timeout
+                        return None
+                    self.stats.counters.increment(
+                        sc.TIMEOUTS_TOTAL
+                        if isinstance(e, StatementTimeout)
+                        else sc.QUERIES_CANCELED)
+                    raise
+                except Exception as e:
+                    if getattr(e, "injected_fault", False):
+                        self.stats.counters.increment(
+                            sc.FAULTS_INJECTED_TOTAL)
+                    retryable = self._retryable_error(e)
+                    # COPY commits each parsed batch independently, so
+                    # re-executing a partially ingested file would
+                    # double-load the committed batches — the failure
+                    # surfaces instead (same double-apply rule as the
+                    # post-visibility seams)
+                    if isinstance(stmt, ast.CopyFrom):
+                        retryable = False
+                    # max_statement_retries=0 switches the whole
+                    # resilient layer off (legacy crash semantics:
+                    # the NEXT session's recovery pass resolves)
+                    if commit_txid is not None and retryable and \
+                            max_retries > 0:
+                        if self._resolve_failed_commit(commit_txid):
+                            return None  # recovery rolled it forward
+                        raise  # rolled back: a clean, reported failure
+                    if not retryable or attempt >= max_retries:
+                        raise
+                    attempt += 1
+                    self.stats.counters.increment(sc.RETRIES_TOTAL)
+                    if activity is not None:
+                        activity.retries = attempt
+                    self._mark_failover(e)
+                    # retries must never observe half-applied state:
+                    # finish any interrupted 2PC before re-executing
+                    # (transaction_recovery.c at the retry boundary).
+                    # Recovery runs deadline-free — an expired deadline
+                    # must not abort the roll-forward it deserves.
+                    if self.txn_manager.current is None:
+                        try:
+                            with deadline_scope(None):
+                                self.txn_manager.recover()
+                        except Exception:
+                            pass  # recovery retries on the next pass
+                    base_s = self.settings.get(
+                        "retry_backoff_base_ms") / 1000.0
+                    cap_s = self.settings.get(
+                        "retry_backoff_max_ms") / 1000.0
+                    delay = base_s * (2 ** (attempt - 1))
+                    delay *= 0.5 + _random.random()  # ±50% jitter
+                    delay = min(cap_s, delay)  # cap AFTER jitter
+                    rem = deadline.remaining()
+                    if rem is not None:
+                        delay = max(0.0, min(delay, rem))
+                    if delay:
+                        # waiting on the cancel event (not time.sleep)
+                        # keeps Session.cancel() prompt even mid-backoff
+                        self._cancel_evt.wait(delay)
+                    # loop: the next check_cancel raises if the sleep
+                    # consumed the deadline or a cancel arrived
+
+    def _retryable_error(self, e: BaseException) -> bool:
+        """Transient ⇒ retry: injected faults (the killed-connection
+        analogue), storage IO.  Semantic errors (parse/planning/catalog/
+        capacity), cancellation, and post-visibility faults are not."""
+        from .errors import QueryCanceled, StorageError
+        from .utils.faultinjection import InjectedFault
+
+        if isinstance(e, QueryCanceled):
+            return False
+        # post-visibility failures (tagged by the seam itself — e.g.
+        # ChangeLog.emit runs after the manifest flip — or recognized by
+        # fault-point name): the effect is committed, a rerun would
+        # double-apply
+        if getattr(e, "post_visibility", False):
+            return False
+        if getattr(e, "fault_point", None) in self._NON_RETRYABLE_POINTS:
+            return False
+        return isinstance(e, (InjectedFault, StorageError, OSError))
+
+    def _mark_failover(self, e: BaseException) -> None:
+        """A failed shard read carries (table, shard_id): mark the
+        placement it routed to as suspect so `catalog.active_placement`
+        re-derives the retry's routing onto a surviving replica, and
+        count the failover when such a replica exists."""
+        from .stats import counters as sc
+
+        shard_id = getattr(e, "shard_id", None)
+        if shard_id is None:
+            return
+        try:
+            p = self.catalog.active_placement(shard_id)
+        except Exception:
+            return
+        if self.catalog.mark_placement_suspect(p.placement_id):
+            self.stats.counters.increment(sc.FAILOVERS_TOTAL)
+
+    def _resolve_failed_commit(self, txid: int) -> bool:
+        """COMMIT died mid-2PC: resolve by the recovery rule instead of
+        re-executing (the transaction state is already torn down).
+        Commit record durable → roll the prepared txn forward (the
+        idempotent apply replays safely over a partial first apply) and
+        the statement SUCCEEDS; no record → recovery discarded the
+        prepare and the original error propagates.  Returns True when
+        rolled forward (transaction_recovery.c's exact rule)."""
+        from .utils.cancellation import deadline_scope
+
+        had_commit_record = self.txn_manager.has_commit_record(txid)
+        try:
+            # deadline-free: an expired statement deadline must not
+            # abort the roll-forward mid-apply (idempotent but the
+            # statement would then misreport a committed txn)
+            with deadline_scope(None):
+                self.txn_manager.recover()
+        except Exception:
+            return False
+        return had_commit_record
 
     def create_distributed_table(self, name: str, distribution_column: str,
                                  shard_count: int | None = None,
@@ -567,10 +745,11 @@ class Session:
         elif e.name == "citus_stat_activity":
             entries = self.stats.activity.entries()
             return ResultSet(
-                ["global_pid", "query", "state"],
+                ["global_pid", "query", "state", "retries"],
                 {"global_pid": [a.gpid for a in entries],
                  "query": [a.query for a in entries],
-                 "state": [a.state for a in entries]}, len(entries))
+                 "state": [a.state for a in entries],
+                 "retries": [a.retries for a in entries]}, len(entries))
         elif e.name == "get_rebalance_progress":
             mons = self.stats.progress.all()
             return ResultSet(
@@ -1010,8 +1189,8 @@ class Session:
 
                 from .stats import counters as sc
 
-                skipped0 = self.stats.counters.snapshot().get(
-                    sc.CHUNKS_SKIPPED, 0)
+                snap0 = self.stats.counters.snapshot()
+                skipped0 = snap0.get(sc.CHUNKS_SKIPPED, 0)
                 t0 = time.perf_counter()
                 result = self.executor.execute_plan(plan)
                 elapsed = time.perf_counter() - t0
@@ -1029,6 +1208,23 @@ class Session:
                 if result.streamed_batches:
                     lines.append("Streamed Execution: "
                                  f"{result.streamed_batches} batches")
+                # this statement's deltas (the Chunks Skipped pattern),
+                # plus session totals clearly labeled as such — a clean
+                # statement in a battle-scarred session must not read
+                # as if IT hit the failures
+                snap = self.stats.counters.snapshot()
+                d_r = snap.get(sc.RETRIES_TOTAL, 0) - \
+                    snap0.get(sc.RETRIES_TOTAL, 0)
+                d_f = snap.get(sc.FAILOVERS_TOTAL, 0) - \
+                    snap0.get(sc.FAILOVERS_TOTAL, 0)
+                lines.append(
+                    f"Resilience: retries={d_r} failovers={d_f} "
+                    "(session totals: retries_total="
+                    f"{snap.get(sc.RETRIES_TOTAL, 0)} failovers_total="
+                    f"{snap.get(sc.FAILOVERS_TOTAL, 0)} timeouts_total="
+                    f"{snap.get(sc.TIMEOUTS_TOTAL, 0)} "
+                    "faults_injected_total="
+                    f"{snap.get(sc.FAULTS_INJECTED_TOTAL, 0)})")
             return ResultSet(["QUERY PLAN"], {"QUERY PLAN": lines},
                              len(lines))
         finally:

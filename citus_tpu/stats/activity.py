@@ -28,6 +28,10 @@ class ActivityEntry:
     query: str
     state: str = "active"
     started_at: float = field(default_factory=time.time)
+    # statement-retry-loop attempts for the in-flight statement (the
+    # resilient executor bumps this so citus_stat_activity shows which
+    # live statements are riding out transient failures)
+    retries: int = 0
 
 
 class ActivityRegistry:

@@ -35,6 +35,12 @@ INSERT_SELECT_REPARTITION = "insert_select_repartition"
 INSERT_SELECT_PULL = "insert_select_pull"
 CHUNKS_SKIPPED = "chunks_skipped"
 QUERIES_STREAMED = "queries_streamed"
+# resilient statement execution (session retry loop / deadline seams)
+RETRIES_TOTAL = "retries_total"
+FAILOVERS_TOTAL = "failovers_total"
+TIMEOUTS_TOTAL = "timeouts_total"
+QUERIES_CANCELED = "queries_canceled"
+FAULTS_INJECTED_TOTAL = "faults_injected_total"
 
 ALL_COUNTERS = [
     QUERIES_SINGLE_SHARD, QUERIES_MULTI_SHARD, QUERIES_REPARTITION,
@@ -44,6 +50,8 @@ ALL_COUNTERS = [
     CAPACITY_RETRIES, DEVICE_ROWS_SCANNED,
     INSERT_SELECT_PUSHDOWN, INSERT_SELECT_REPARTITION, INSERT_SELECT_PULL,
     CHUNKS_SKIPPED, QUERIES_STREAMED,
+    RETRIES_TOTAL, FAILOVERS_TOTAL, TIMEOUTS_TOTAL, QUERIES_CANCELED,
+    FAULTS_INJECTED_TOTAL,
 ]
 
 

@@ -118,8 +118,13 @@ def lookup(store, table: str, shard_id: int, column: str,
                 # per-writer tmp name: two sessions rebuilding the same
                 # stale index concurrently must not interleave writes
                 # into ONE tmp file and os.replace a torn npz — each
-                # writer publishes its own complete file atomically
-                tmp = f"{path}.tmp.{os.getpid()}.npz"
+                # writer publishes its own complete file atomically.
+                # Sessions are in-process objects, so the writer id
+                # needs the THREAD, not just the pid.
+                import threading as _threading
+
+                tmp = (f"{path}.tmp.{os.getpid()}."
+                       f"{_threading.get_ident()}.npz")
                 files = np.asarray([f for f, _r in sig])
                 rows = np.asarray([r for _f, r in sig], dtype=np.int64)
                 np.savez(tmp, keys=keys, stripe_idx=sidx, row_pos=rpos,

@@ -26,6 +26,7 @@ import threading
 import numpy as np
 
 from ..catalog import Catalog
+from ..errors import StorageError
 from ..utils.io import atomic_write_json
 from .dictionary import Dictionary
 from .format import StripeReader, write_stripe
@@ -605,7 +606,27 @@ class TableStore:
     def read_shard(self, table: str, shard_id: int,
                    columns: list[str] | None = None, chunk_filter=None,
                    ) -> tuple[dict[str, np.ndarray], dict[str, np.ndarray], int]:
-        """Concatenate all visible stripes of one shard (projected)."""
+        """Concatenate all visible stripes of one shard (projected).
+
+        A failed read carries (table, shard_id) on the exception so the
+        statement retry loop can mark the placement suspect and fail the
+        next attempt's routing over to a surviving replica — the
+        adaptive-executor read-failover seam."""
+        from ..utils.faultinjection import fault_point
+
+        try:
+            fault_point("store.read_shard")
+            return self._read_shard(table, shard_id, columns, chunk_filter)
+        except Exception as e:
+            if isinstance(e, (StorageError, OSError)) or \
+                    getattr(e, "injected_fault", False):
+                e.table = table
+                e.shard_id = shard_id
+            raise
+
+    def _read_shard(self, table: str, shard_id: int,
+                    columns: list[str] | None = None, chunk_filter=None,
+                    ) -> tuple[dict[str, np.ndarray], dict[str, np.ndarray], int]:
         meta = self.catalog.table(table)
         columns = columns or meta.schema.names
         vals: dict[str, list[np.ndarray]] = {c: [] for c in columns}

@@ -189,6 +189,12 @@ class TransactionManager:
         """Finish interrupted transactions; → (committed, discarded)."""
         return recover_transactions(self.store, self.log_dir)
 
+    def has_commit_record(self, txid: int) -> bool:
+        """Whether `txid`'s commit record is durable — recovery WILL
+        roll it forward (the statement retry loop uses this to resolve
+        a COMMIT that died mid-2PC without re-executing it)."""
+        return os.path.exists(os.path.join(self._txn_dir(txid), "commit"))
+
 
 def _fsync_dir(path: str) -> None:
     fd = os.open(path, os.O_RDONLY)

@@ -1,0 +1,75 @@
+"""Cooperative statement deadlines + cross-thread cancel.
+
+The reference enforces `citus.node_connection_timeout` per worker
+connection and relays PostgreSQL's statement_timeout/cancel interrupts
+into the adaptive executor's wait loops (adaptive_executor.c event
+processing).  Single-controller mapping: each executing statement
+installs one thread-local `Deadline`; the existing seams — named fault
+points, stream/COPY batch boundaries, the overflow-retry loop, statement
+retry iterations — call `check_cancel()` and raise
+`StatementTimeout`/`QueryCanceled` when the deadline passed or another
+thread called `Session.cancel()`.
+
+The check is a thread-local read + one clock read: cheap enough to sit
+on every seam, and a no-op on threads with no statement in flight
+(background daemons, prefetch producers).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+
+from ..errors import QueryCanceled, StatementTimeout
+
+_tls = threading.local()
+
+
+class Deadline:
+    """One statement's cancellation state: an optional wall-clock expiry
+    plus an optional cross-thread cancel event."""
+
+    __slots__ = ("expires_at", "cancel_evt")
+
+    def __init__(self, timeout_ms: float | None,
+                 cancel_evt: threading.Event | None = None):
+        self.expires_at = (time.monotonic() + timeout_ms / 1000.0
+                           if timeout_ms else None)
+        self.cancel_evt = cancel_evt
+
+    def remaining(self) -> float | None:
+        """Seconds until expiry; None = no deadline."""
+        if self.expires_at is None:
+            return None
+        return self.expires_at - time.monotonic()
+
+
+def current_deadline() -> Deadline | None:
+    return getattr(_tls, "deadline", None)
+
+
+@contextlib.contextmanager
+def deadline_scope(timeout_ms: float | None,
+                   cancel_evt: threading.Event | None = None):
+    """Install a per-statement deadline on this thread (nestable: an
+    inner scope shadows, the outer one is restored on exit)."""
+    prev = getattr(_tls, "deadline", None)
+    _tls.deadline = Deadline(timeout_ms, cancel_evt)
+    try:
+        yield _tls.deadline
+    finally:
+        _tls.deadline = prev
+
+
+def check_cancel() -> None:
+    """Raise if the current statement was canceled or timed out; no-op
+    on threads without an installed deadline."""
+    d = getattr(_tls, "deadline", None)
+    if d is None:
+        return
+    if d.cancel_evt is not None and d.cancel_evt.is_set():
+        raise QueryCanceled("canceling statement due to user request")
+    if d.expires_at is not None and time.monotonic() > d.expires_at:
+        raise StatementTimeout(
+            "canceling statement due to statement timeout")

@@ -1,36 +1,97 @@
-"""Fault-injection points for storage and transaction boundaries.
+"""Fault-injection points for storage, executor and transaction seams.
 
 The reference injects failures by interposing mitmproxy between
 coordinator and worker and killing/delaying traffic at named moments
 (`citus.mitmproxy('conn.onQuery(query="COMMIT").kill()')` —
 /root/reference/src/test/regress/mitmscripts/README.md:1-60, fluent.py).
 Single-controller mapping: the process boundaries to break are the
-storage writes and the 2PC steps, so named fault points sit at those
-seams and tests arm them:
+storage reads/writes, the device feed/execute steps, and the 2PC steps,
+so named fault points sit at those seams and tests arm them:
 
-    with inject("txn.commit_record", after=0):
+    with inject("txn.commit_record"):
         session.execute("COMMIT")      # dies right before the record
 
-Armed points raise InjectedFault after `after` passes through; the
-default (unarmed) cost is a dict lookup.
+The engine mirrors mitmproxy's fluent vocabulary:
+
+* ``kill`` — the default: raise at the seam (`error="injected"` raises
+  InjectedFault, `error="storage"` raises StorageError — the
+  "connection lost" vs "disk error" distinction the retry classifier
+  cares about);
+* ``delay`` — ``sleep=0.05`` sleeps at the seam first; with
+  ``error=None`` the fault is delay-only (mitmproxy's ``delay()``);
+* ``after=N`` — trigger only after N successful passes
+  (``allow(N).kill()``);
+* ``times=N`` / ``once=False`` — sticky multi-shot faults: trigger N
+  times (or forever) before disarming;
+* ``p=0.3, seed=…`` — probabilistic faults with a deterministic
+  per-spec RNG (the chaos soak uses these).
+
+Armed points trigger as configured; the default (unarmed) cost is a
+dict emptiness check.  Every `fault_point()` call is also a cooperative
+cancellation seam (utils/cancellation.check_cancel), so statement
+timeouts fire wherever faults can.
+
+``python -m citus_tpu.utils.faultinjection --list`` prints the registry
+of named points (tests assert each is armed by at least one test).
 """
 
 from __future__ import annotations
 
 import contextlib
+import random
 import threading
+import time
+
+from ..errors import ExecutionError, StorageError
+from .cancellation import check_cancel
 
 
-class InjectedFault(Exception):
-    """Raised at an armed fault point (the 'connection killed' analogue)."""
+class InjectedFault(ExecutionError):
+    """Raised at an armed fault point (the 'connection killed' analogue).
 
+    Subclasses ExecutionError so a surfaced injection is still a *clean*
+    CitusTpuError — the chaos-soak invariant every statement must meet."""
+
+
+# Static registry: every named seam in the codebase, with the module
+# that hosts it.  `fault_point()` also registers dynamically, but tests
+# assert against THIS list so a new seam must be declared (and armed by
+# at least one test) to ship.
+FAULT_POINTS: dict[str, str] = {
+    "store.append_stripe": "storage/table_store.py — shard stripe write",
+    "store.apply_dml": "storage/table_store.py — DML manifest flip",
+    "store.read_shard": "storage/table_store.py — shard stripe read",
+    "executor.overflow_retry": "executor/runner.py — capacity regrow",
+    "executor.plan_cache_fill": "executor/runner.py — compiled-plan insert",
+    "executor.device_put": "executor/feed.py — host→HBM placement",
+    "executor.repartition_shuffle":
+        "executor/insert_select.py — INSERT..SELECT repartition write",
+    "stream.prefetch": "executor/stream.py — batch prefetch thread",
+    "catalog.placement_probe": "catalog/catalog.py — active-placement pick",
+    "txn.prepare": "transaction/manager.py — before PREPARE",
+    "txn.commit_record": "transaction/manager.py — prepared, no record",
+    "txn.apply": "transaction/manager.py — record durable, not applied",
+    "cdc.append": "cdc/feed.py — change-journal append",
+    "operations.shard_move": "operations/shard_transfer.py — mid-move",
+}
 
 _lock = threading.Lock()
 _armed: dict[str, dict] = {}
+_injected_total = 0  # module-wide trigger count (all sessions)
+
+
+def registered_points() -> dict[str, str]:
+    return dict(FAULT_POINTS)
+
+
+def injected_total() -> int:
+    return _injected_total
 
 
 def fault_point(name: str) -> None:
-    """Called at instrumented seams; raises when armed and triggered."""
+    """Called at instrumented seams; triggers when armed.  Also a
+    cooperative cancellation point for the executing statement."""
+    check_cancel()
     if not _armed:
         return
     with _lock:
@@ -40,23 +101,91 @@ def fault_point(name: str) -> None:
         if spec["after"] > 0:
             spec["after"] -= 1
             return
-        if spec.get("once", True):
-            del _armed[name]
-    raise InjectedFault(f"injected fault at {name!r}")
+        if spec["p"] < 1.0 and spec["rng"].random() >= spec["p"]:
+            return
+        times = spec["times"]
+        if times is not None:
+            if times <= 1:
+                del _armed[name]
+            else:
+                spec["times"] = times - 1
+        sleep = spec["sleep"]
+        kind = spec["error"]
+        global _injected_total
+        _injected_total += 1
+    if sleep:
+        time.sleep(sleep)  # delay fault (outside the lock)
+    if kind is None:
+        return  # delay-only
+    if kind == "storage":
+        exc: Exception = StorageError(
+            f"injected storage fault at {name!r}")
+    else:
+        exc = InjectedFault(f"injected fault at {name!r}")
+    exc.fault_point = name
+    exc.injected_fault = True
+    raise exc
+
+
+def arm(name: str, after: int = 0, once: bool = True,
+        times: int | None = None, p: float = 1.0, sleep: float = 0.0,
+        error: str | None = "injected", seed: int | None = None) -> None:
+    """Arm `name`.  `times` (trigger count before disarm) overrides
+    `once`; `once=False, times=None` stays armed forever.  `error` picks
+    the raised kind ('injected' | 'storage') or None for delay-only."""
+    if error not in (None, "injected", "storage"):
+        raise ValueError(f"unknown fault error kind {error!r}")
+    with _lock:
+        _armed[name] = {
+            "after": after,
+            "times": (times if times is not None
+                      else (1 if once else None)),
+            "p": p, "sleep": sleep, "error": error,
+            "rng": random.Random(seed),
+        }
+
+
+def disarm(name: str) -> None:
+    with _lock:
+        _armed.pop(name, None)
 
 
 @contextlib.contextmanager
-def inject(name: str, after: int = 0, once: bool = True):
-    """Arm `name` to raise after `after` successful passes."""
-    with _lock:
-        _armed[name] = {"after": after, "once": once}
+def inject(name: str, after: int = 0, once: bool = True,
+           times: int | None = None, p: float = 1.0, sleep: float = 0.0,
+           error: str | None = "injected", seed: int | None = None):
+    """Arm `name` for the duration of the block (see `arm`)."""
+    arm(name, after=after, once=once, times=times, p=p, sleep=sleep,
+        error=error, seed=seed)
     try:
         yield
     finally:
-        with _lock:
-            _armed.pop(name, None)
+        disarm(name)
+
+
+def armed_points() -> list[str]:
+    with _lock:
+        return sorted(_armed)
 
 
 def reset() -> None:
     with _lock:
         _armed.clear()
+
+
+def main(argv: list[str] | None = None) -> int:
+    """`python -m citus_tpu.utils.faultinjection --list` debug helper."""
+    import sys
+
+    argv = sys.argv[1:] if argv is None else argv
+    if not argv or argv[0] in ("--list", "list"):
+        for name in sorted(FAULT_POINTS):
+            print(f"{name:32s} {FAULT_POINTS[name]}")
+        return 0
+    print("usage: python -m citus_tpu.utils.faultinjection --list",
+          file=sys.stderr)
+    return 2
+
+
+if __name__ == "__main__":  # pragma: no cover
+    raise SystemExit(main())

@@ -431,3 +431,148 @@ def shrink(q: Fuzz, still_fails) -> Fuzz:
             except Exception:
                 continue  # shrink candidate itself invalid — skip
     return q
+
+
+# ---------------------------------------------------------------------------
+# chaos mode: a mixed read/write workload with a host-side oracle model
+#
+# The soak harness (tests/test_chaos.py) runs these statements across
+# multiple sessions under randomly armed fault points and asserts the
+# invariant: every statement either agrees with the model or raises a
+# clean CitusTpuError with the store uncorrupted.  Shapes are drawn from
+# FIXED pools so the whole workload compiles a handful of mesh programs,
+# not one per statement.
+
+
+@dataclass
+class ChaosStmt:
+    """One chaos statement plus its oracle hooks.
+
+    kind: insert | update | delete | read | begin | commit | copy
+    effect(model): mutate the id→v dict the way the statement commits
+    expect(model): expected result rows for a read
+    rows: payload for kind == "copy" (the harness writes the CSV and
+    fills in the COPY ... FROM sql itself)
+    """
+
+    sql: str
+    kind: str
+    effect: object = None
+    expect: object = None
+    rows: list | None = None
+
+
+CHAOS_FILTER_POOL = [50, 500, 5000]   # fixed: bounds compiled plan count
+CHAOS_DELTA_POOL = [1, 3, 7]
+CHAOS_RANGE_POOL = [(0, 40), (20, 120), (100, 400), (0, 10**9)]
+
+
+def _chaos_insert(rng: random.Random, state: dict) -> list[ChaosStmt]:
+    k = rng.randint(1, 4)
+    rows = []
+    for _ in range(k):
+        rid = state["next_id"]
+        state["next_id"] += 1
+        rows.append((rid, rng.choice(CHAOS_FILTER_POOL) + rng.randint(0, 9)))
+    sql = "INSERT INTO kv VALUES " + ", ".join(
+        f"({i}, {v})" for i, v in rows)
+
+    def effect(model):
+        model.update(rows)
+
+    return [ChaosStmt(sql, "insert", effect=effect)]
+
+
+def _chaos_copy(rng: random.Random, state: dict) -> list[ChaosStmt]:
+    k = rng.randint(3, 8)
+    rows = []
+    for _ in range(k):
+        rid = state["next_id"]
+        state["next_id"] += 1
+        rows.append((rid, rng.choice(CHAOS_FILTER_POOL)))
+
+    def effect(model):
+        model.update(rows)
+
+    return [ChaosStmt("", "copy", effect=effect, rows=rows)]
+
+
+def _chaos_update(rng: random.Random, state: dict) -> list[ChaosStmt]:
+    lo, hi = rng.choice(CHAOS_RANGE_POOL)
+    d = rng.choice(CHAOS_DELTA_POOL)
+    sql = (f"UPDATE kv SET v = v + {d} "
+           f"WHERE id >= {lo} AND id < {hi}")
+
+    def effect(model):
+        for rid in model:
+            if lo <= rid < hi:
+                model[rid] += d
+
+    return [ChaosStmt(sql, "update", effect=effect)]
+
+
+def _chaos_delete(rng: random.Random, state: dict,
+                  model_keys: list) -> list[ChaosStmt]:
+    if not model_keys:
+        return _chaos_insert(rng, state)
+    rid = rng.choice(model_keys)
+    sql = f"DELETE FROM kv WHERE id = {rid}"
+
+    def effect(model):
+        model.pop(rid, None)
+
+    return [ChaosStmt(sql, "delete", effect=effect)]
+
+
+def _chaos_read(rng: random.Random) -> list[ChaosStmt]:
+    if rng.random() < 0.5:
+        def expect(model):
+            n = len(model)
+            return [(n, sum(model.values()) if n else None)]
+
+        return [ChaosStmt("SELECT count(*), sum(v) FROM kv", "read",
+                          expect=expect)]
+    c = rng.choice(CHAOS_FILTER_POOL)
+
+    def expect(model):
+        return [(sum(1 for v in model.values() if v >= c),)]
+
+    return [ChaosStmt(f"SELECT count(*) FROM kv WHERE v >= {c}", "read",
+                      expect=expect)]
+
+
+def _chaos_txn(rng: random.Random, state: dict) -> list[ChaosStmt]:
+    """BEGIN / one-or-two updates / COMMIT — the 2PC dance under chaos.
+    Effects ride the COMMIT: nothing applies to the model unless the
+    commit statement succeeds."""
+    body = _chaos_update(rng, state)
+    if rng.random() < 0.5:
+        body += _chaos_update(rng, state)
+    effects = [s.effect for s in body]
+
+    def commit_effect(model):
+        for eff in effects:
+            eff(model)
+
+    return ([ChaosStmt("BEGIN", "begin")]
+            + [ChaosStmt(s.sql, s.kind) for s in body]
+            + [ChaosStmt("COMMIT", "commit", effect=commit_effect)])
+
+
+def generate_chaos(rng: random.Random, state: dict,
+                   model: dict) -> list[ChaosStmt]:
+    """One chaos operation → 1..4 statements (transactions span several).
+    `state` holds the fresh-id counter; `model` is the shared id→v
+    oracle (read-only here — effects apply it on statement success)."""
+    roll = rng.random()
+    if roll < 0.30:
+        return _chaos_read(rng)
+    if roll < 0.50:
+        return _chaos_insert(rng, state)
+    if roll < 0.65:
+        return _chaos_update(rng, state)
+    if roll < 0.75:
+        return _chaos_delete(rng, state, sorted(model))
+    if roll < 0.85:
+        return _chaos_copy(rng, state)
+    return _chaos_txn(rng, state)

@@ -225,3 +225,88 @@ class TestConfig:
         assert s.get("enable_repartition_joins") is False
         with pytest.raises(ConfigError, match="invalid boolean"):
             s.set("enable_repartition_joins", "treu")
+
+
+class TestMaybeReloadPreservesTemps:
+    """catalog.maybe_reload must MERGE the fresh on-disk catalog with
+    this session's live in-memory temp reference tables — a wholesale
+    replacement drops a mid-statement __intermediate_* CTE
+    materialization the outer query is about to scan (ADVICE r5)."""
+
+    def _disk_catalog(self, tmp_path):
+        cat = Catalog()
+        cat.add_node("device:1")
+        cat.create_local_table("base", ORDERS)
+        path = str(tmp_path / "catalog.json")
+        cat.save(path)
+        return path
+
+    def test_reload_keeps_live_temp_tables(self, tmp_path):
+        path = self._disk_catalog(tmp_path)
+        mine = Catalog.load(path)
+        # a statement materializes a CTE as a temp reference table
+        # (in memory only — temps are never persisted)
+        mine.create_reference_table("__intermediate_7", NATION)
+        temp_shard = mine.table_shards("__intermediate_7")[0]
+        # meanwhile another session commits DDL to the shared catalog
+        other = Catalog.load(path)
+        other.create_local_table("newtab", LINEITEM)
+        other.save(path)
+        assert mine.maybe_reload(path)
+        # the committed DDL was adopted AND the live temp survived
+        assert mine.has_table("newtab")
+        assert mine.has_table("__intermediate_7")
+        shards = mine.table_shards("__intermediate_7")
+        assert [s.shard_id for s in shards] == [temp_shard.shard_id]
+        assert mine.shard_placements(temp_shard.shard_id)
+        # temps allocate from the reserved high range so the merge can
+        # never clobber a shard id another session committed to disk
+        from citus_tpu.catalog.catalog import TEMP_ID_BASE
+
+        assert temp_shard.shard_id >= TEMP_ID_BASE
+        # and the other session's committed shards all survived intact
+        assert mine.table_shards("newtab")
+        assert mine.table_shards("base")
+
+    def test_reload_during_statement_with_live_temp(self, tmp_path):
+        """End-to-end: a session holding a live temp mid-statement
+        adopts another session's commit without losing the temp's scan
+        (the seam session.execute hits via catalog.maybe_reload)."""
+        import citus_tpu
+
+        data_dir = str(tmp_path / "data")
+        s1 = citus_tpu.connect(data_dir=data_dir, n_devices=2)
+        s2 = citus_tpu.connect(data_dir=data_dir, n_devices=2)
+        s1.execute("CREATE TABLE t (id INT, v INT)")
+        s1.execute("SELECT create_distributed_table('t', 'id', 2)")
+        s1.execute("INSERT INTO t VALUES (1, 10), (2, 20), (3, 30)")
+        # hook the store so the reload fires while the temp is live:
+        # after the CTE materializes (reference-table append), another
+        # session commits DDL and s1's catalog reloads mid-statement
+        orig_append = s1.store.append_stripe
+        fired = {"n": 0}
+
+        def append_hook(table, *a, **kw):
+            rec = orig_append(table, *a, **kw)
+            if table.startswith("__intermediate_") and not fired["n"]:
+                fired["n"] += 1
+                s2.execute("CREATE TABLE other (x INT)")
+                import os
+
+                s1.catalog.maybe_reload(
+                    os.path.join(data_dir, "catalog.json"))
+            return rec
+
+        s1.store.append_stripe = append_hook
+        try:
+            r = s1.execute(
+                "WITH c AS (SELECT id, v FROM t WHERE v >= 20) "
+                "SELECT count(*), sum(v) FROM c")
+        finally:
+            s1.store.append_stripe = orig_append
+        assert fired["n"] == 1
+        assert [tuple(int(x) for x in row) for row in r.rows()] == \
+            [(2, 50)]
+        assert s1.catalog.has_table("other")
+        s1.close()
+        s2.close()

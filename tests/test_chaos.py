@@ -1,0 +1,194 @@
+"""Chaos soak: a mixed read/write workload under randomized armed faults.
+
+The reference proves resilience by running regress suites through
+mitmproxy kill/delay interposition (src/test/regress/mitmscripts/) and
+asserting queries either answer correctly or fail cleanly.  Here the
+fault engine (citus_tpu/utils/faultinjection.py) arms random named
+points around a generated workload (tests/fuzzer.py chaos mode) across
+two sessions sharing one data_dir, and the soak asserts the invariant:
+
+    every statement either agrees with the host-side oracle model or
+    raises a clean CitusTpuError — and the store stays uncorrupted
+    (post-soak recover_transactions() + full-table checksum agree
+    across live sessions, a fresh session, and the model).
+
+A failed WRITE has an inherently ambiguous outcome (the fault may have
+hit before or after the visibility flip — the lost-COMMIT-ack problem),
+so the harness reconciles the model from the store after a clean write
+failure; reads are never ambiguous and must match exactly.
+
+`-m chaos` selects these; the full soak is additionally `slow` (tier-1
+runs the deterministic smoke slice only).
+"""
+
+import os
+import random
+
+import pytest
+
+import citus_tpu
+from citus_tpu.errors import CitusTpuError
+from citus_tpu.utils import faultinjection as fi
+from fuzzer import generate_chaos
+
+pytestmark = pytest.mark.chaos
+
+# points armed by the soak, spanning read, write, device, catalog and
+# 2PC seams.  cdc.append is IN even though it is non-retryable (it
+# exercises the post-visibility classification); delay-only and
+# storage-kind variants exercise the classifier's other branches.
+FAULT_POOL = [
+    dict(name="store.read_shard"),
+    dict(name="store.read_shard", error="storage"),
+    dict(name="store.append_stripe"),
+    dict(name="store.append_stripe", after=1),
+    dict(name="store.apply_dml"),
+    dict(name="executor.device_put"),
+    dict(name="executor.plan_cache_fill"),
+    dict(name="executor.repartition_shuffle"),
+    dict(name="catalog.placement_probe"),
+    dict(name="stream.prefetch"),
+    dict(name="txn.prepare"),
+    dict(name="txn.commit_record"),
+    dict(name="txn.apply"),
+    dict(name="cdc.append"),
+    dict(name="store.read_shard", error=None, sleep=0.005),
+    dict(name="store.read_shard", p=0.5, times=2),
+]
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    fi.reset()
+    yield
+    fi.reset()
+
+
+def _read_store(sess) -> dict:
+    rows = sess.execute("SELECT id, v FROM kv").rows()
+    return {int(i): int(v) for i, v in rows}
+
+
+def _run_soak(tmp_path, n_ops: int, seed: int, fault_rate: float):
+    rng = random.Random(seed)
+    data_dir = str(tmp_path / "chaos")
+    mk = lambda: citus_tpu.connect(  # noqa: E731
+        data_dir=data_dir, n_devices=2, retry_backoff_base_ms=1,
+        retry_backoff_max_ms=5, max_statement_retries=2,
+        shard_replication_factor=2)
+    sessions = [mk(), mk()]
+    s0 = sessions[0]
+    s0.execute("CREATE TABLE kv (id INT, v INT)")
+    s0.execute("SELECT create_distributed_table('kv', 'id', 4)")
+
+    model: dict[int, int] = {}
+    state = {"next_id": 0}
+    # seed rows so early reads/deletes have substance
+    seed_rows = [(state["next_id"] + i, 100 + i) for i in range(40)]
+    state["next_id"] += 40
+    s0.execute("INSERT INTO kv VALUES " + ", ".join(
+        f"({i}, {v})" for i, v in seed_rows))
+    model.update(seed_rows)
+
+    stats = {"ops": 0, "stmts": 0, "armed": 0, "clean_failures": 0,
+             "reconciled": 0}
+    while stats["ops"] < n_ops:
+        stats["ops"] += 1
+        sess = sessions[stats["ops"] % len(sessions)]
+        script = generate_chaos(rng, state, model)
+        armed = None
+        if rng.random() < fault_rate:
+            spec = dict(rng.choice(FAULT_POOL))
+            armed = spec.pop("name")
+            fi.arm(armed, seed=rng.randrange(1 << 30), **spec)
+            stats["armed"] += 1
+        in_txn = False
+        try:
+            failed = False
+            for stmt in script:
+                stats["stmts"] += 1
+                if stmt.kind == "begin":
+                    in_txn = True
+                if failed:
+                    break  # abandon the rest of a failed script
+                sql = stmt.sql
+                csv = None
+                if stmt.kind == "copy":
+                    csv = str(tmp_path / f"copy_{stats['ops']}.csv")
+                    with open(csv, "w") as f:
+                        for i, v in stmt.rows:
+                            f.write(f"{i},{v}\n")
+                    sql = f"COPY kv FROM '{csv}' WITH (FORMAT csv)"
+                try:
+                    r = sess.execute(sql)
+                except Exception as e:
+                    # THE invariant: failures are clean framework errors
+                    assert isinstance(e, CitusTpuError), (
+                        f"unclean failure {type(e).__name__}: {e!r} "
+                        f"running {sql!r}")
+                    stats["clean_failures"] += 1
+                    failed = True
+                    if stmt.kind == "commit":
+                        in_txn = False  # manager tears the txn down
+                    continue
+                if stmt.kind == "commit":
+                    in_txn = False
+                if stmt.kind == "read":
+                    got = [tuple(None if x is None else int(x)
+                                 for x in row) for row in r.rows()]
+                    want = stmt.expect(model)
+                    assert got == want, (
+                        f"oracle mismatch on {sql!r}: {got} != {want}")
+                elif stmt.effect is not None:
+                    stmt.effect(model)
+            if failed:
+                if in_txn:
+                    try:
+                        sess.execute("ROLLBACK")
+                    except Exception:
+                        pass
+                # ambiguous write outcome: adopt the store's committed
+                # truth (reads above are never reconciled)
+                fi.reset()
+                model = _read_store(sessions[0])
+                stats["reconciled"] += 1
+        finally:
+            if armed is not None:
+                fi.disarm(armed)
+    # ---- post-soak: store uncorrupted ------------------------------------
+    for sess in sessions:
+        committed, discarded = sess.txn_manager.recover()
+        # a second pass is a no-op: recovery is idempotent
+        assert sess.txn_manager.recover() == (0, 0)
+    checksums = [_read_store(sess) for sess in sessions]
+    fresh = citus_tpu.connect(data_dir=data_dir, n_devices=2)
+    checksums.append(_read_store(fresh))
+    assert checksums[0] == checksums[1] == checksums[2], \
+        "sessions disagree on committed state (store corrupted)"
+    assert checksums[0] == model, "model diverged from committed state"
+    for sess in sessions:
+        sess.close()
+    fresh.close()
+    return stats
+
+
+class TestChaosSoak:
+    def test_smoke_slice(self, tmp_path):
+        """Deterministic-seed smoke slice: small enough for tier-1."""
+        stats = _run_soak(tmp_path, n_ops=45, seed=1234, fault_rate=0.35)
+        assert stats["armed"] >= 8  # soak actually injected chaos
+
+    @pytest.mark.slow
+    def test_full_soak(self, tmp_path):
+        """Acceptance soak: ≥200 statements, ≥25% fault-armed, mixed
+        DML/SELECT/COPY over 2 sessions, zero oracle mismatches, zero
+        corruption."""
+        stats = _run_soak(tmp_path, n_ops=160, seed=20260803,
+                          fault_rate=0.4)
+        assert stats["stmts"] >= 200
+        assert stats["armed"] >= 0.25 * stats["ops"]
+
+    @pytest.mark.slow
+    def test_soak_second_seed(self, tmp_path):
+        stats = _run_soak(tmp_path, n_ops=120, seed=99, fault_rate=0.3)
+        assert stats["stmts"] >= 120

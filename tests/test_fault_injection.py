@@ -36,6 +36,12 @@ def totals(sess):
 
 
 class TestInjectedCrashes:
+    """Single-attempt atomicity: sessions here run with
+    max_statement_retries=0 where the assertion is about what ONE failed
+    attempt leaves behind (the resilient retry layer would otherwise
+    absorb the injected fault; its behavior is TestResilientExecution's
+    subject)."""
+
     def test_crash_before_commit_record_rolls_back(self, tmp_data_dir):
         sess = citus_tpu.connect(data_dir=tmp_data_dir)
         setup_accounts(sess)
@@ -49,7 +55,10 @@ class TestInjectedCrashes:
         assert totals(fresh) == (8, 3600)
 
     def test_crash_after_commit_record_rolls_forward(self, tmp_data_dir):
-        sess = citus_tpu.connect(data_dir=tmp_data_dir)
+        # retries off: hand the died commit to the NEXT session's
+        # recovery pass instead of resolving it in-place
+        sess = citus_tpu.connect(data_dir=tmp_data_dir,
+                                 max_statement_retries=0)
         setup_accounts(sess)
         sess.execute("BEGIN")
         sess.execute("UPDATE acc SET bal = 0 WHERE id = 1")
@@ -62,7 +71,8 @@ class TestInjectedCrashes:
 
     def test_ingest_failure_after_n_stripes_leaks_nothing(self,
                                                           tmp_data_dir):
-        sess = citus_tpu.connect(data_dir=tmp_data_dir)
+        sess = citus_tpu.connect(data_dir=tmp_data_dir,
+                                 max_statement_retries=0)
         sess.execute("CREATE TABLE t (id INT, v INT)")
         sess.execute("SELECT create_distributed_table('t', 'id', 4)")
         vals = ", ".join(f"({i}, {i})" for i in range(200))
@@ -85,7 +95,8 @@ class TestInjectedCrashes:
             "SELECT count(*) FROM t").rows()[0][0]) == 200
 
     def test_dml_apply_failure_keeps_old_state(self, tmp_data_dir):
-        sess = citus_tpu.connect(data_dir=tmp_data_dir)
+        sess = citus_tpu.connect(data_dir=tmp_data_dir,
+                                 max_statement_retries=0)
         setup_accounts(sess)
         with inject("store.apply_dml"):
             with pytest.raises(InjectedFault):
@@ -200,7 +211,8 @@ class TestRound4Seams:
     get breakable seams too)."""
 
     def test_stream_prefetch_death_surfaces_as_error(self, tmp_data_dir):
-        sess = citus_tpu.connect(data_dir=tmp_data_dir, n_devices=1)
+        sess = citus_tpu.connect(data_dir=tmp_data_dir, n_devices=1,
+                                 max_statement_retries=0)
         sess.execute("CREATE TABLE big (id INT, v INT)")
         sess.execute("SELECT create_distributed_table('big', 'id', 2)")
         vals = ", ".join(f"({i}, {i % 7})" for i in range(3000))
@@ -218,7 +230,8 @@ class TestRound4Seams:
     def test_overflow_retry_death_leaves_executor_usable(self,
                                                          tmp_data_dir):
         sess = citus_tpu.connect(data_dir=tmp_data_dir, n_devices=1,
-                                 join_output_capacity_factor=0.1)
+                                 join_output_capacity_factor=0.1,
+                                 max_statement_retries=0)
         sess.execute("CREATE TABLE a (k INT, v INT)")
         sess.execute("SELECT create_distributed_table('a', 'k', 2)")
         sess.execute("CREATE TABLE b (k INT, w INT)")
@@ -257,7 +270,8 @@ class TestRound4Seams:
         assert lsns == sorted(lsns) and len(set(lsns)) == len(lsns)
 
     def test_shard_move_death_keeps_old_placement(self, tmp_data_dir):
-        sess = citus_tpu.connect(data_dir=tmp_data_dir, n_devices=1)
+        sess = citus_tpu.connect(data_dir=tmp_data_dir, n_devices=1,
+                                 max_statement_retries=0)
         sess.execute("CREATE TABLE t (id INT, v INT)")
         sess.execute("SELECT create_distributed_table('t', 'id', 2)")
         sess.execute("INSERT INTO t VALUES (1, 1), (2, 2)")
@@ -460,3 +474,340 @@ class TestPairwiseRound4:
             assert c == sv
             assert 4000 <= c <= 4005
         assert counts == sorted(counts)
+
+
+class TestResilientExecution:
+    """The statement-level resilience envelope (session retry loop +
+    placement failover + cooperative deadline) — the adaptive executor's
+    task retry/failover hoisted to the statement level."""
+
+    def _mk(self, data_dir, **kw):
+        kw.setdefault("retry_backoff_base_ms", 1)
+        kw.setdefault("retry_backoff_max_ms", 5)
+        return citus_tpu.connect(data_dir=data_dir, **kw)
+
+    def test_transient_read_fault_retried_transparently(self,
+                                                        tmp_data_dir):
+        sess = self._mk(tmp_data_dir)
+        setup_accounts(sess)
+        from citus_tpu.stats import counters as sc
+
+        with inject("store.read_shard"):
+            assert totals(sess) == (8, 3600)
+        snap = sess.stats.counters.snapshot()
+        assert snap[sc.RETRIES_TOTAL] >= 1
+        assert snap[sc.FAULTS_INJECTED_TOTAL] >= 1
+
+    def test_shard_read_kill_fails_over_to_replica(self, tmp_data_dir):
+        # acceptance: a shard read killed mid-SELECT is answered
+        # correctly via replica failover within max_statement_retries
+        sess = self._mk(tmp_data_dir, n_devices=2,
+                        shard_replication_factor=2)
+        setup_accounts(sess)
+        from citus_tpu.stats import counters as sc
+
+        shard = sess.catalog.table_shards("acc")[0]
+        assert len(sess.catalog.shard_placements(shard.shard_id)) == 2
+        before = {s.shard_id: sess.catalog.active_placement(s.shard_id)
+                  .placement_id for s in sess.catalog.table_shards("acc")}
+        with inject("store.read_shard", error="storage"):
+            assert totals(sess) == (8, 3600)
+        snap = sess.stats.counters.snapshot()
+        assert snap[sc.FAILOVERS_TOTAL] >= 1
+        after = {s.shard_id: sess.catalog.active_placement(s.shard_id)
+                 .placement_id for s in sess.catalog.table_shards("acc")}
+        assert after != before  # at least one shard re-routed
+
+    def test_sticky_fault_exhausts_retries_cleanly(self, tmp_data_dir):
+        sess = self._mk(tmp_data_dir, max_statement_retries=2)
+        setup_accounts(sess)
+        from citus_tpu.errors import CitusTpuError
+
+        with inject("store.read_shard", once=False, error="storage"):
+            with pytest.raises(CitusTpuError):
+                sess.execute("SELECT count(*) FROM acc")
+        # the session stays fully usable
+        assert totals(sess) == (8, 3600)
+
+    def test_statement_timeout_cancels_streaming_query(self,
+                                                       tmp_data_dir):
+        # acceptance: statement_timeout_ms=50 cancels a streaming query
+        # cleanly, with the counters visible in EXPLAIN ANALYZE
+        sess = self._mk(tmp_data_dir, n_devices=1)
+        sess.execute("CREATE TABLE big (id INT, v INT)")
+        sess.execute("SELECT create_distributed_table('big', 'id', 2)")
+        vals = ", ".join(f"({i}, 1)" for i in range(3000))
+        sess.execute(f"INSERT INTO big VALUES {vals}")
+        sess.execute("SET max_feed_bytes_per_device = 1; "
+                     "SET stream_batch_rows = 256")
+        from citus_tpu.errors import StatementTimeout
+        from citus_tpu.stats import counters as sc
+
+        sess.execute("SET statement_timeout_ms = 50")
+        with inject("stream.prefetch", once=False, error=None,
+                    sleep=0.03):
+            with pytest.raises(StatementTimeout):
+                sess.execute("SELECT count(*), sum(v) FROM big")
+        assert sess.stats.counters.snapshot()[sc.TIMEOUTS_TOTAL] == 1
+        sess.execute("SET statement_timeout_ms = 0")
+        r = sess.execute("SELECT count(*), sum(v) FROM big")
+        assert int(r.rows()[0][0]) == 3000
+        r = sess.execute("EXPLAIN ANALYZE SELECT count(*) FROM big")
+        res_lines = [x for x in r.columns["QUERY PLAN"]
+                     if x.startswith("Resilience:")]
+        assert len(res_lines) == 1
+        assert "timeouts_total=1" in res_lines[0]
+        assert "retries_total=" in res_lines[0]
+        assert "failovers_total=" in res_lines[0]
+
+    def test_cross_thread_cancel(self, tmp_data_dir):
+        sess = self._mk(tmp_data_dir, n_devices=1)
+        sess.execute("CREATE TABLE big (id INT, v INT)")
+        sess.execute("SELECT create_distributed_table('big', 'id', 2)")
+        vals = ", ".join(f"({i}, 1)" for i in range(3000))
+        sess.execute(f"INSERT INTO big VALUES {vals}")
+        sess.execute("SET max_feed_bytes_per_device = 1; "
+                     "SET stream_batch_rows = 256")
+        from citus_tpu.errors import QueryCanceled
+
+        errs = []
+
+        def run():
+            try:
+                sess.execute("SELECT count(*) FROM big")
+            except BaseException as e:
+                errs.append(e)
+
+        with inject("stream.prefetch", once=False, error=None,
+                    sleep=0.02):
+            t = threading.Thread(target=run)
+            t.start()
+            import time
+
+            time.sleep(0.1)
+            sess.cancel()
+            t.join(30)
+        assert len(errs) == 1 and isinstance(errs[0], QueryCanceled)
+        # session usable again (fresh execute clears the cancel flag)
+        r = sess.execute("SELECT count(*) FROM big")
+        assert int(r.rows()[0][0]) == 3000
+
+    def test_commit_retry_resolves_by_rolling_forward(self, tmp_data_dir):
+        # fault AFTER the commit record: the resilient layer resolves
+        # the died COMMIT through recovery (roll-forward) and the
+        # statement SUCCEEDS — applied exactly once
+        sess = self._mk(tmp_data_dir)
+        setup_accounts(sess)
+        sess.execute("BEGIN")
+        sess.execute("UPDATE acc SET bal = 0 WHERE id = 1")
+        with inject("txn.apply"):
+            sess.execute("COMMIT")  # no raise
+        assert totals(sess) == (8, 3600 - 200)
+        fresh = citus_tpu.connect(data_dir=tmp_data_dir)
+        assert totals(fresh) == (8, 3600 - 200)
+
+    def test_recovery_under_retry_no_double_apply(self, tmp_data_dir):
+        # satellite: a commit over TWO tables dies after applying the
+        # first — the retry path's recover_transactions() replays the
+        # prepared txn over its own partial first attempt, and the
+        # idempotent apply_dml must not double-apply table one
+        sess = self._mk(tmp_data_dir)
+        for t in ("ta", "tb"):
+            sess.execute(f"CREATE TABLE {t} (id INT, v INT)")
+            sess.execute(f"SELECT create_distributed_table('{t}', 'id', 2)")
+            sess.execute(f"INSERT INTO {t} VALUES " + ", ".join(
+                f"({i}, 100)" for i in range(8)))
+        sess.execute("BEGIN")
+        sess.execute("UPDATE ta SET v = v + 5 WHERE id < 4")
+        sess.execute("UPDATE tb SET v = v + 7 WHERE id < 4")
+        with inject("store.apply_dml", after=1):
+            sess.execute("COMMIT")  # ta applied, tb dies; recovery replays
+        r = sess.execute("SELECT sum(v) FROM ta").rows()[0][0]
+        assert int(r) == 8 * 100 + 4 * 5
+        r = sess.execute("SELECT sum(v) FROM tb").rows()[0][0]
+        assert int(r) == 8 * 100 + 4 * 7
+        # and a fresh session agrees (nothing half-applied on disk)
+        fresh = citus_tpu.connect(data_dir=tmp_data_dir)
+        assert int(fresh.execute(
+            "SELECT sum(v) FROM ta").rows()[0][0]) == 8 * 100 + 4 * 5
+
+    def test_post_visibility_fault_is_not_retried(self, tmp_data_dir):
+        # cdc.append fires after the manifest flip: re-executing would
+        # double-apply, so the error must surface even with retries on
+        sess = self._mk(tmp_data_dir, max_statement_retries=3)
+        setup_accounts(sess)
+        with inject("cdc.append"):
+            with pytest.raises(InjectedFault):
+                sess.execute("UPDATE acc SET bal = bal + 1")
+        from citus_tpu.stats import counters as sc
+
+        assert sess.stats.counters.snapshot()[sc.RETRIES_TOTAL] == 0
+
+    def test_activity_exposes_retry_column(self, tmp_data_dir):
+        sess = self._mk(tmp_data_dir)
+        r = sess.execute("SELECT citus_stat_activity()")
+        assert "retries" in r.column_names
+
+    def test_delay_and_probabilistic_faults(self, tmp_data_dir):
+        import time
+
+        from citus_tpu.utils.faultinjection import arm, disarm, fault_point
+
+        # delay-only fault: slows the seam, never raises
+        arm("unit.delay", sleep=0.02, error=None, once=False)
+        try:
+            t0 = time.perf_counter()
+            fault_point("unit.delay")
+            fault_point("unit.delay")
+            assert time.perf_counter() - t0 >= 0.03
+        finally:
+            disarm("unit.delay")
+        # probabilistic fault with a pinned seed triggers eventually,
+        # deterministically
+        arm("unit.prob", p=0.5, seed=7, once=False)
+        try:
+            fired = 0
+            for _ in range(20):
+                try:
+                    fault_point("unit.prob")
+                except InjectedFault:
+                    fired += 1
+            assert 0 < fired < 20
+        finally:
+            disarm("unit.prob")
+        # sticky multi-shot: exactly N triggers then disarmed
+        arm("unit.times", times=2)
+        try:
+            hits = 0
+            for _ in range(5):
+                try:
+                    fault_point("unit.times")
+                except InjectedFault:
+                    hits += 1
+            assert hits == 2
+        finally:
+            disarm("unit.times")
+
+
+class TestFaultPointRegistry:
+    """`fault_points --list` tooling: the registry is the contract —
+    every source seam is declared, and every declared seam is armed by
+    at least one test (the satellite's coverage gate)."""
+
+    def test_list_helper_prints_registry(self, capsys):
+        from citus_tpu.utils.faultinjection import main, registered_points
+
+        assert main(["--list"]) == 0
+        out = capsys.readouterr().out
+        for name in registered_points():
+            assert name in out
+
+    def test_registry_matches_source_call_sites(self):
+        import glob
+        import os
+        import re
+
+        import citus_tpu
+        from citus_tpu.utils.faultinjection import registered_points
+
+        pkg = os.path.dirname(citus_tpu.__file__)
+        called = set()
+        for path in glob.glob(os.path.join(pkg, "**", "*.py"),
+                              recursive=True):
+            with open(path) as f:
+                called.update(re.findall(r'fault_point\("([^"]+)"\)',
+                                         f.read()))
+        called.discard("name")  # the definition site's own docstring
+        assert called == set(registered_points()), (
+            "fault-point registry out of sync with source call sites")
+
+    def test_every_registered_point_armed_by_a_test(self):
+        import glob
+        import os
+
+        from citus_tpu.utils.faultinjection import registered_points
+
+        test_dir = os.path.dirname(__file__)
+        src = ""
+        for path in glob.glob(os.path.join(test_dir, "*.py")):
+            with open(path) as f:
+                src += f.read()
+        unarmed = [name for name in registered_points()
+                   if f'"{name}"' not in src and f"'{name}'" not in src]
+        assert not unarmed, f"fault points never armed by any test: {unarmed}"
+
+
+class TestRetryClassificationEdges:
+    """Review findings: seams where a retry would double-apply."""
+
+    def test_copy_is_never_retried(self, tmp_data_dir, tmp_path):
+        # COPY commits per batch: a statement retry would double-load
+        # the already-committed batches, so failures surface instead
+        sess = citus_tpu.connect(data_dir=tmp_data_dir,
+                                 max_statement_retries=3,
+                                 retry_backoff_base_ms=1)
+        sess.execute("CREATE TABLE kv (id INT, v INT)")
+        sess.execute("SELECT create_distributed_table('kv', 'id', 2)")
+        csv = str(tmp_path / "rows.csv")
+        with open(csv, "w") as f:
+            for i in range(50):
+                f.write(f"{i},{i}\n")
+        from citus_tpu.stats import counters as sc
+
+        with inject("store.append_stripe"):
+            with pytest.raises(InjectedFault):
+                sess.execute(f"COPY kv FROM '{csv}' WITH (FORMAT csv)")
+        assert sess.stats.counters.snapshot()[sc.RETRIES_TOTAL] == 0
+        # no duplicated rows on a manual re-run
+        sess.execute(f"COPY kv FROM '{csv}' WITH (FORMAT csv)")
+        assert int(sess.execute(
+            "SELECT count(*) FROM kv").rows()[0][0]) == 50
+
+    def test_real_oserror_in_change_log_not_retried(self, tmp_data_dir):
+        # a REAL OSError escaping ChangeLog.emit (post-manifest-flip) is
+        # tagged post-visibility and must not be retried even though
+        # OSError is otherwise in the retryable class
+        sess = citus_tpu.connect(data_dir=tmp_data_dir,
+                                 max_statement_retries=3,
+                                 retry_backoff_base_ms=1)
+        setup_accounts(sess)
+        emit = sess.store.change_log._emit
+        calls = {"n": 0}
+
+        def failing_emit(events):
+            if events and not calls["n"]:
+                calls["n"] += 1
+                raise OSError("disk full writing change journal")
+            return emit(events)
+
+        sess.store.change_log._emit = failing_emit
+        try:
+            with pytest.raises(OSError):
+                sess.execute("UPDATE acc SET bal = bal + 1")
+        finally:
+            sess.store.change_log._emit = emit
+        from citus_tpu.stats import counters as sc
+
+        assert sess.stats.counters.snapshot()[sc.RETRIES_TOTAL] == 0
+        # the effect WAS committed (post-visibility): applied once
+        assert totals(sess) == (8, 3608)
+
+    def test_timeout_during_commit_resolves_truthfully(self,
+                                                       tmp_data_dir):
+        # a deadline expiring inside the 2PC after the commit record is
+        # durable must not report a timeout for a committed txn — the
+        # resolution path rolls it forward and the COMMIT succeeds
+        sess = citus_tpu.connect(data_dir=tmp_data_dir,
+                                 retry_backoff_base_ms=1)
+        setup_accounts(sess)
+        sess.execute("BEGIN")
+        sess.execute("UPDATE acc SET bal = 0 WHERE id = 1")
+        # the deadline comfortably outlives prepare + commit-record
+        # fsyncs; the delay fault then consumes it at the txn.apply seam
+        # (after its own check_cancel), so the NEXT seam inside the
+        # apply raises with the commit record already durable
+        sess.execute("SET statement_timeout_ms = 400")
+        with inject("txn.apply", error=None, sleep=0.5):
+            sess.execute("COMMIT")  # resolved as success, no raise
+        sess.execute("SET statement_timeout_ms = 0")
+        assert totals(sess) == (8, 3600 - 200)

@@ -617,7 +617,10 @@ class TestRecoveryInterleavings:
         # session see the rolled-forward state exactly once
         from citus_tpu.utils import faultinjection as fi
 
-        s1 = make_session(tmp_path)
+        # retries off: the resilient layer would otherwise resolve the
+        # died commit in-place (roll-forward) — this test wants the
+        # crash handed to the NEXT session's recovery pass
+        s1 = make_session(tmp_path, max_statement_retries=0)
         n, sm = setup(s1, rows=10)
         s1.execute("BEGIN")
         s1.execute("UPDATE t SET v = v + 1")
