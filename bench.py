@@ -74,18 +74,22 @@ def bench_cold_scan(sess, n_rows: int):
     aggregate, with the HBM feed cache emptied first (the plan stays
     compiled — this measures the data path, not XLA).
 
-    Returns (rate, best, parts): `parts` decomposes the cold time into
-    host decode (stripe read + decompress, measured separately over the
-    same columns) vs the remainder (device_put through whatever link
-    attaches the chip + dispatch).  On the tunnel-attached measurement
-    rig the transfer leg dominates — the sub-metrics let the published
-    line say so without a PERF_NOTES cross-reference."""
+    Returns (rate, best, parts, reps): `parts` decomposes the cold time
+    into host decode (stripe read + decompress, measured separately over
+    the same columns) vs the remainder (device_put through whatever link
+    attaches the chip + dispatch); `reps` is the measured-execution
+    count the caller stamps on the emitted line, so the published
+    repeats can never drift from the loop that actually ran.  On the
+    tunnel-attached measurement rig the transfer leg dominates — the
+    sub-metrics let the published line say so without a PERF_NOTES
+    cross-reference."""
     sql = ("select sum(l_quantity), sum(l_extendedprice), "
            "sum(l_discount), sum(l_tax) from lineitem")
     sess.execute(sql)  # compile + warm
     bytes_scanned = n_rows * 4 * 8  # four float64 columns as stored
     best = float("inf")
-    for _ in range(2):
+    reps = 2
+    for _ in range(reps):
         sess.executor.feed_cache.clear()
         t0 = time.perf_counter()
         r = sess.execute(sql)
@@ -95,7 +99,7 @@ def bench_cold_scan(sess, n_rows: int):
     cols = ["l_quantity", "l_extendedprice", "l_discount", "l_tax"]
     decode_best = float("inf")
     decoded_bytes = 0
-    for _ in range(2):
+    for _ in range(reps):
         sess.store._manifests.clear()
         t0 = time.perf_counter()
         decoded_bytes = 0
@@ -112,7 +116,7 @@ def bench_cold_scan(sess, n_rows: int):
         "bytes_decoded": decoded_bytes,
         "bytes_to_device": bytes_scanned,
     }
-    return bytes_scanned / best / 1e9, best, parts
+    return bytes_scanned / best / 1e9, best, parts, reps
 
 
 def main() -> None:
@@ -156,7 +160,8 @@ def main() -> None:
         pass
 
     def emit(name, rate, best, this_sf, unit="rows/s",
-             baseline=BASELINE_ROWS_PER_SEC, extra=None, reps=None):
+             baseline=BASELINE_ROWS_PER_SEC, extra=None, reps=None,
+             sess_obj=None):
         line = {
             "metric": name,
             "value": round(rate, 3 if unit != "rows/s" else 1),
@@ -167,11 +172,19 @@ def main() -> None:
         }
         if extra:
             line.update(extra)
-        if rep_override and reps is not None:
-            # the ACTUAL measured-execution count for this line (a
+        if reps is not None:
+            # the ACTUAL measured-execution count for EVERY line (a
             # config default above BENCH_REPEAT runs its default) —
-            # the artifact must describe what actually ran
+            # the artifact must describe what actually ran, not just
+            # the lines BENCH_REPEAT happened to touch
             line["repeats"] = reps
+        # cumulative plan-cache traffic of the emitting session at the
+        # moment this line lands: warm-vs-cold is auditable from the
+        # JSON alone (a config whose misses didn't grow ran entirely
+        # on cached executables)
+        s = sess_obj if sess_obj is not None else sess
+        line["plan_cache_hits"] = s.executor.plan_cache.hits
+        line["plan_cache_misses"] = s.executor.plan_cache.misses
         cpu = cpu_rows.get(name)
         if cpu and cpu.get("sf") == this_sf and cpu.get("rows_per_sec"):
             line["vs_cpu"] = round(rate / cpu["rows_per_sec"], 3)
@@ -204,6 +217,14 @@ def main() -> None:
              "where o_custkey = l_suppkey",
              n_ord + n_li),
             ("tpch_q3_rows_per_sec", QUERIES["Q3"], n_cust + n_ord + n_li),
+            # high-cardinality GROUP BY (~0.25·n_li distinct orderkeys
+            # over the full lineitem): the aggregation-stage wall the
+            # bucketed dense-grid path (ops/groupby.py, group_by_kernel)
+            # targets — bench_kernels.py groupby is the kernel-level A/B
+            ("high_card_groupby_rows_per_sec",
+             "select l_orderkey, count(*), sum(l_quantity) "
+             "from lineitem group by l_orderkey",
+             n_li),
         ]
         distinct_extras = {"approx_count_distinct_rows_per_sec",
                            "exact_count_distinct_rows_per_sec"}
@@ -230,16 +251,17 @@ def main() -> None:
             emit(name, rate, best, sf, reps=repeats)
         if ((only is None or "columnar_scan_gb_per_sec" in only)
                 and not over_budget(0.7)):
-            rate, best, parts = bench_cold_scan(sess, n_li)
+            rate, best, parts, scan_reps = bench_cold_scan(sess, n_li)
             emit("columnar_scan_gb_per_sec", rate, best, sf, unit="GB/s",
-                 baseline=BASELINE_SCAN_GB_PER_SEC, extra=parts)
+                 baseline=BASELINE_SCAN_GB_PER_SEC, extra=parts,
+                 reps=scan_reps)
             # the host-only decode leg as its own line: on a
             # tunnel-attached rig the end-to-end number above measures
             # the link, not the stripe reader
             emit("columnar_host_decode_gb_per_sec",
                  parts["host_decode_gb_per_sec"],
                  parts["host_decode_seconds"], sf, unit="GB/s",
-                 baseline=BASELINE_SCAN_GB_PER_SEC)
+                 baseline=BASELINE_SCAN_GB_PER_SEC, reps=scan_reps)
 
         # -- INSERT..SELECT modes (reference README: pushdown ~100M vs
         #    repartition ~10M rows/s — here the colocated path writes
@@ -310,7 +332,7 @@ def main() -> None:
                     "where o_custkey = l_suppkey",
                     n_ord10 + n_li10, r)
                 emit("dual_repartition_join_sf10_rows_per_sec", rate,
-                     best, sf10_scale, reps=r)
+                     best, sf10_scale, reps=r, sess_obj=s10)
             if "single_repartition_join_sf10_rows_per_sec" in sf10_run:
                 # the SF1 config is tunnel-latency-bound (~14 ms of
                 # device work behind a ~95 ms round trip); at SF10 the
@@ -323,13 +345,13 @@ def main() -> None:
                     "where c_custkey = o_custkey",
                     n_cust10 + n_ord10, r)
                 emit("single_repartition_join_sf10_rows_per_sec", rate,
-                     best, sf10_scale, reps=r)
+                     best, sf10_scale, reps=r, sess_obj=s10)
             if "tpch_q3_sf10_rows_per_sec" in sf10_run:
                 r = n_reps(2)
                 rate, best = bench_query(
                     s10, QUERIES["Q3"], n_cust10 + n_ord10 + n_li10, r)
                 emit("tpch_q3_sf10_rows_per_sec", rate, best,
-                     sf10_scale, reps=r)
+                     sf10_scale, reps=r, sess_obj=s10)
 
         # headline LAST (driver contract: final JSON line)
         if only is None or "tpch_q1_rows_per_sec" in only:

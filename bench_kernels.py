@@ -65,7 +65,7 @@ def xla_onehot_matmul(slot, values, total):
         preferred_element_type=jnp.float32)[:total]
 
 
-def main():
+def main(regimes=None):
     from citus_tpu.ops.pallas_kernels import (
         dense_grid_aggregate_pallas,
         pallas_available,
@@ -77,9 +77,11 @@ def main():
           f"pallas: {pallas_available()}")
     rng = np.random.default_rng(0)
     rows = []
-    for n, k in [(1 << 20, 16), (1 << 20, 512), (1 << 20, 4096),
-                 (1 << 23, 16), (1 << 23, 512), (1 << 23, 4096),
-                 (1 << 23, 8192)]:
+    if regimes is None:
+        regimes = [(1 << 20, 16), (1 << 20, 512), (1 << 20, 4096),
+                   (1 << 23, 16), (1 << 23, 512), (1 << 23, 4096),
+                   (1 << 23, 8192)]
+    for n, k in regimes:
         slot = jnp.asarray(rng.integers(0, k, n).astype(np.int32))
         vals = jnp.asarray(rng.uniform(0, 100, (n, 6)).astype(np.float32))
 
@@ -115,6 +117,7 @@ def main():
             opts["pallas"] = t_pl
         best_counts[min(opts, key=opts.get)] += 1
     print("winner histogram:", best_counts)
+    return rows
 
 
 def _slope_time(fn, repeats=3, reps=8):
@@ -231,6 +234,141 @@ def bench_probe(regimes=None, repeats=3, reps=8):
     return rows
 
 
+def bench_groupby(regimes=None, repeats=3, reps=8):
+    """High-cardinality GROUP BY A/B (round 7): the sort path
+    (packed-key `segment_aggregate`, exactly what the executor runs)
+    vs the bucketed dense-grid path (`ops.groupby.
+    bucketed_grid_aggregate`) in its XLA and Pallas formulations — the
+    aggregation twin of `bench_probe`, and the measurement behind the
+    planner's `group_bucket_eligible` gate and the `group_by_kernel`
+    config var.
+
+    Prints a rows/s table across (n, k) regimes (k = packed slot-space
+    size) and a winner histogram.  Runs on any backend — the 8-device
+    CPU test mesh included, with smaller default regimes there; the
+    authoritative hardware numbers are whatever the driver captures on
+    a real chip.  Pallas is TIMED only off-CPU (interpret mode is not
+    a measurement) but its outputs are parity-checked via a small
+    interpreted run.
+
+    Usage:  python bench_kernels.py groupby
+    """
+    from citus_tpu.runtime import ensure_jax_configured
+
+    ensure_jax_configured()  # int64 packed keys need x64 standalone
+    import citus_tpu.ops.groupby as G
+    from citus_tpu.ops.aggregate import segment_aggregate
+    from citus_tpu.ops.pallas_kernels import pallas_available
+
+    platform = jax.devices()[0].platform
+    if regimes is None:
+        regimes = ([(1 << 18, 4096), (1 << 18, 1 << 16),
+                    (1 << 20, 4096), (1 << 20, 1 << 18)]
+                   if platform == "cpu" else
+                   # TPU: the ISSUE grid — n ∈ {1M, 8M}, k ∈ {4k,
+                   # 64k, 1M} (k > n regimes are planner-ineligible:
+                   # occupancy < 1/4 keeps the sort path)
+                   [(1 << 20, 4096), (1 << 20, 1 << 16),
+                    (1 << 20, 1 << 20),
+                    (1 << 23, 4096), (1 << 23, 1 << 16),
+                    (1 << 23, 1 << 20)])
+    print(f"backend: {platform} ({jax.devices()[0].device_kind}); "
+          f"pallas: {pallas_available()}; "
+          f"tile = {G.GROUP_TILE_SLOTS} slots")
+    rng = np.random.default_rng(0)
+    if pallas_available() and platform == "cpu":
+        # CPU: the Pallas kernel is never TIMED (interpret mode is not
+        # a measurement) but its outputs ARE parity-checked once via a
+        # small interpreted run — full bench sizes would take minutes
+        # per grid step under the interpreter
+        pn, pk = 1 << 12, 256
+        ps = jnp.asarray(rng.integers(0, pk, pn).astype(np.int32))
+        pv = jnp.asarray(rng.uniform(0, 10, pn).astype(np.float32))
+        pvalid = jnp.ones(pn, bool)
+        pcap = pn
+        args = (ps, pvalid, [(pv, "sum")], pk, pcap)
+        rx = G.bucketed_grid_aggregate(*args, kernel="xla")
+        rp = G.bucketed_grid_aggregate(*args, kernel="pallas",
+                                       interpret=True)
+        pall_ok = bool(np.allclose(np.asarray(rx[0][0]),
+                                   np.asarray(rp[0][0]),
+                                   rtol=1e-4, atol=1e-2))
+        print(f"pallas interpret parity (n={pn}, k={pk}): {pall_ok}")
+    rows = []
+    for n, k in regimes:
+        slot0 = jnp.asarray(rng.integers(0, k, n).astype(np.int64))
+        valid = jnp.asarray(rng.random(n) > 0.05)
+        v0 = jnp.asarray(rng.uniform(0, 100, n).astype(np.float32))
+        v1 = jnp.asarray(rng.uniform(0, 100, n).astype(np.float32))
+        ones = jnp.asarray(np.ones(n, np.int32))
+        nb = G.group_bucket_count(k)
+        # uniform slots with 2× skew headroom: overflow-free by design
+        cap = -(-n // nb) * 2 + 128
+
+        def sort_path(i):
+            s = (slot0 + i) % k
+            packed = jnp.where(valid, s, jnp.iinfo(jnp.int64).max)
+            _gk, res, _gv, ng = segment_aggregate(
+                [packed],
+                [(v0, "sum", None), (v1, "sum", None),
+                 (ones, "count", None)], valid, out_keys=[s])
+            return (res[2].sum() + ng).astype(jnp.int64)
+
+        def bucketed(i, kernel="xla", interpret=False):
+            s32 = ((slot0 + i) % k).astype(jnp.int32)
+            res, rps, ov, _fill = G.bucketed_grid_aggregate(
+                s32, valid,
+                [(v0, "sum"), (v1, "sum"), (ones, "count")],
+                k, cap, kernel=kernel, interpret=interpret)
+            # fold overflow in so a capacity bug cannot be silently
+            # timed as a win (it stays 0 by construction)
+            return (res[2].sum().astype(jnp.int64)
+                    + (rps > 0).sum() + ov).astype(jnp.int64)
+
+        # correctness gate before timing: identical row totals AND
+        # identical live-group counts — per formulation, so a Pallas
+        # parity failure cannot implicate the XLA result (and a broken
+        # path can never be crowned winner below)
+        want = int(jax.device_get(sort_path(jnp.int64(0))))
+        ok_xla = want == int(jax.device_get(bucketed(jnp.int64(0))))
+        t_sort = _slope_time(sort_path, repeats, reps)
+        t_bx = _slope_time(bucketed, repeats, reps)
+        t_bp = None
+        ok_pallas = True
+        if pallas_available() and platform != "cpu":
+            try:
+                f_bp = functools.partial(bucketed, kernel="pallas")
+                ok_pallas = want == int(jax.device_get(
+                    f_bp(jnp.int64(0))))
+                t_bp = _slope_time(f_bp, repeats, reps)
+            except Exception as e:
+                ok_pallas = False
+                print(f"  pallas failed at k={k}: "
+                      f"{str(e).splitlines()[0][:120]}")
+        rows.append((n, k, t_sort, t_bx if ok_xla else None,
+                     t_bp if ok_pallas else None,
+                     ok_xla and ok_pallas))
+        bp = ("n/a" if t_bp is None
+              else f"{n / t_bp / 1e6:8.1f}M/s")
+        print(f"n=2^{n.bit_length() - 1} k={k:>8}  "
+              f"sort={n / t_sort / 1e6:8.1f}M/s  "
+              f"bucketed_xla={n / t_bx / 1e6:8.1f}M/s "
+              f"(correct={ok_xla})  "
+              f"bucketed_pallas={bp} (correct={ok_pallas})")
+    best = {"sort": 0, "bucketed_xla": 0, "bucketed_pallas": 0}
+    for _n, _k, t_sort, t_bx, t_bp, _ok in rows:
+        # only formulations that passed their own correctness gate
+        # compete (an incorrect path must never be timed as a win)
+        opts = {"sort": t_sort}
+        if t_bx is not None:
+            opts["bucketed_xla"] = t_bx
+        if t_bp is not None:
+            opts["bucketed_pallas"] = t_bp
+        best[min(opts, key=opts.get)] += 1
+    print("winner histogram:", best)
+    return rows
+
+
 def bench_stripe_codec(gb: float = 0.5):
     """Native C++ stripe decode vs the pure-Python chunk loop —
     host-side only, no device, no tunnel (VERDICT r3 item 4).
@@ -293,5 +431,7 @@ if __name__ == "__main__":
 
     if len(sys.argv) > 1 and sys.argv[1] == "probe":
         bench_probe()
+    elif len(sys.argv) > 1 and sys.argv[1] == "groupby":
+        bench_groupby()
     else:
         main()

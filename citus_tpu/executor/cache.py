@@ -82,7 +82,9 @@ def node_fingerprint(node: PlanNode) -> str:
         return (f"A({node.combine};{node.repart_keys};"
                 f"{node_fingerprint(node.input)};"
                 f"{groups};{aggs};{node.dense_keys};{node.dense_total};"
-                f"{node.key_ranges};{_dist_sig(node.dist)})")
+                f"{node.key_ranges};{node.bucket_keys};"
+                f"{node.bucket_total};{node.group_bucketed};"
+                f"{_dist_sig(node.dist)})")
     raise TypeError(f"unknown plan node {type(node).__name__}")
 
 
@@ -103,7 +105,9 @@ def caps_signature(plan: QueryPlan, caps) -> tuple:
             tuple(sorted((order[k], v) for k, v in caps.scan_out.items())),
             caps.output_repart,
             tuple(sorted((order[k], v)
-                         for k, v in caps.bucket_probe.items())))
+                         for k, v in caps.bucket_probe.items())),
+            tuple(sorted((order[k], v)
+                         for k, v in caps.agg_bucket.items())))
 
 
 def feeds_signature(plan: QueryPlan, feeds) -> tuple:

@@ -229,6 +229,11 @@ class Capacities:
     # probe_bucketed): the packed probe buffer is [n_buckets, this];
     # skew overflows and regrows through the normal retry path
     bucket_probe: dict[int, int] = None
+    # per-bucket row slots for bucketed dense-grid aggregation
+    # (AggregateNode.bucket_keys): the packed input buffer is
+    # [n_buckets, this]; a hot bucket overflows and regrows through
+    # the normal retry path, feedback tightens at 0.85
+    agg_bucket: dict[int, int] = None
 
     def __post_init__(self):
         if self.agg_out is None:
@@ -237,6 +242,8 @@ class Capacities:
             self.scan_out = {}
         if self.bucket_probe is None:
             self.bucket_probe = {}
+        if self.agg_bucket is None:
+            self.agg_bucket = {}
 
     def grown(self, overflow: int) -> "Capacities":
         """Retry sizing: at least double, and at least enough for the
@@ -253,7 +260,8 @@ class Capacities:
                           {k: g(v) for k, v in self.scan_out.items()},
                           g(self.output_repart)
                           if self.output_repart else None,
-                          {k: g(v) for k, v in self.bucket_probe.items()})
+                          {k: g(v) for k, v in self.bucket_probe.items()},
+                          {k: g(v) for k, v in self.agg_bucket.items()})
 
 
 class PlanCompiler:
@@ -261,7 +269,8 @@ class PlanCompiler:
 
     def __init__(self, plan: QueryPlan, mesh: Mesh,
                  feeds: dict[int, FeedSpec], caps: Capacities,
-                 compute_dtype=np.float32, probe_kernel: str = "xla"):
+                 compute_dtype=np.float32, probe_kernel: str = "xla",
+                 group_kernel: str = "auto"):
         self.plan = plan
         self.mesh = mesh
         self.feeds = feeds
@@ -272,6 +281,13 @@ class PlanCompiler:
         # hardware-measured choice (bench_kernels.bench_probe), part of
         # the plan-cache key in the runner
         self.probe_kernel = probe_kernel
+        # group-by path pick ('auto' | 'sort' | 'bucketed' |
+        # 'bucketed_pallas'): auto defers to the planner's TPU-gated
+        # group_bucketed annotation; the rest override it where the
+        # plan is structurally eligible (bench_kernels.py groupby is
+        # the measurement behind the default).  Part of the plan-cache
+        # key in the runner, like probe_kernel.
+        self.group_kernel = group_kernel
 
     # ------------------------------------------------------------------
     def build(self):
@@ -1293,16 +1309,20 @@ class PlanCompiler:
         return segment_aggregate(key_arrays, values, valid)
 
     def _pack_group_keys(self, node: AggregateNode, key_arrays, key_meta,
-                         valid):
+                         valid, kr=None):
         """Composite group keys → ONE int64 sort key, using the
-        planner's statically-known ranges (key_ranges).  Returns
-        (packed [n] | None, oob scalar): single-operand argsorts are far
-        faster on TPU than the multi-operand lexsort; rows whose key
-        falls outside the planned range are COUNTED (they would alias
-        another slot) so the dense_oob retry recompiles with packing
-        off.  The null slot is always reserved — runtime null masks may
-        exist even when the planner believed a key non-nullable."""
-        kr = getattr(node, "key_ranges", None)
+        planner's statically-known ranges (key_ranges, or the explicit
+        `kr` a caller passes — the bucketed grid reuses this exact
+        layout for its slot ids so the two paths cannot diverge on
+        null/oob edge cases).  Returns (packed [n] | None, oob scalar):
+        single-operand argsorts are far faster on TPU than the
+        multi-operand lexsort; rows whose key falls outside the planned
+        range are COUNTED (they would alias another slot) so the
+        dense_oob retry recompiles with packing off.  The null slot is
+        always reserved — runtime null masks may exist even when the
+        planner believed a key non-nullable."""
+        if kr is None:
+            kr = getattr(node, "key_ranges", None)
         if kr is None or self.caps.dense_off or len(kr) != len(key_meta):
             return None, None
         expected = len(key_meta) + sum(1 for _c, f in key_meta if f)
@@ -1333,6 +1353,28 @@ class PlanCompiler:
         # collision with a real slot)
         packed = jnp.where(valid, packed, jnp.iinfo(jnp.int64).max)
         return packed, oob
+
+    @staticmethod
+    def agg_bucket_shape(node: AggregateNode, group_kernel: str,
+                         dense_off: bool) -> bool:
+        """Single decision point for the bucketed dense-grid group-by:
+        capacity planning (Capacities.agg_bucket sizing), the compiler
+        dispatch, EXPLAIN's tag and the groupby_bucketed_total counter
+        must all agree, or a compiled plan would look up per-bucket
+        capacities that were never allocated."""
+        if dense_off or node.combine not in ("local", "repartition"):
+            return False
+        if not getattr(node, "bucket_keys", None) or \
+                getattr(node, "bucket_total", 0) <= 0:
+            return False
+        if node.dense_keys is not None:
+            return False  # below the cap the flat dense grid wins
+        if group_kernel == "sort":
+            return False
+        if group_kernel in ("bucketed", "bucketed_pallas"):
+            return True
+        # auto: the planner's measurement-gated (TPU-only) pick
+        return bool(getattr(node, "group_bucketed", False))
 
     @staticmethod
     def agg_pushdown_shape(node: AggregateNode) -> bool:
@@ -1451,6 +1493,15 @@ class PlanCompiler:
         if node.dense_keys is not None and not self.caps.dense_off and \
                 node.combine in ("local", "repartition"):
             return self._exec_dense_aggregate(node, blk)
+        if self.agg_bucket_shape(node, self.group_kernel,
+                                 self.caps.dense_off) and \
+                id(node) in self.caps.agg_bucket:
+            bucketed = self._exec_bucketed_aggregate(node, blk)
+            if bucketed is not None:
+                return bucketed
+            # None is a defensive invariant check (see the helper) —
+            # with today's _agg_inputs/bucket_keys invariants it cannot
+            # fire; falling through lands on the sort path regardless
         key_arrays, key_meta, values = self._agg_inputs(node, blk)
 
         if node.combine == "global":
@@ -1668,22 +1719,9 @@ class PlanCompiler:
                 else:
                     results[i] = red[:, j]
 
-        # cross-device combine (repartition → collectives; local → none)
-        if node.combine == "repartition":
-            rows_per_slot = jax.lax.psum(rows_per_slot, SHARD_AXIS)
-            for i, (v, kind, _vv) in enumerate(values):
-                if kind in ("count", "sum"):
-                    results[i] = jax.lax.psum(results[i], SHARD_AXIS)
-                elif kind == "min":
-                    results[i] = jax.lax.pmin(results[i], SHARD_AXIS)
-                else:
-                    results[i] = jax.lax.pmax(results[i], SHARD_AXIS)
-                if companions[i] is not None:
-                    companions[i] = jax.lax.psum(companions[i], SHARD_AXIS)
-            out_valid = (rows_per_slot > 0) & \
-                (jax.lax.axis_index(SHARD_AXIS) == 0)
-        else:
-            out_valid = rows_per_slot > 0
+        results, companions, rows_per_slot, out_valid = \
+            self._combine_grid(node, values, results, companions,
+                               rows_per_slot)
 
         # reconstruct key columns from the slot grid
         iota = jnp.arange(total, dtype=jnp.int32)
@@ -1706,6 +1744,156 @@ class PlanCompiler:
             if companions[i] is not None:
                 nulls[cid] = companions[i] == 0
         return Block(cols, out_valid, nulls)
+
+    @staticmethod
+    def _combine_grid(node: AggregateNode, values, results, companions,
+                      rows_per_slot):
+        """Cross-device combine shared by the flat and bucketed dense
+        grids (repartition → psum/pmin/pmax over the slot grid, device
+        0 emits; local → per-device slots).  One implementation so the
+        two paths' NULL-companion and combine semantics cannot
+        diverge.  Returns (results, companions, rows_per_slot,
+        out_valid)."""
+        if node.combine == "repartition":
+            rows_per_slot = jax.lax.psum(rows_per_slot, SHARD_AXIS)
+            for i, (_v, kind, _vv) in enumerate(values):
+                if kind in ("count", "sum"):
+                    results[i] = jax.lax.psum(results[i], SHARD_AXIS)
+                elif kind == "min":
+                    results[i] = jax.lax.pmin(results[i], SHARD_AXIS)
+                else:
+                    results[i] = jax.lax.pmax(results[i], SHARD_AXIS)
+                if companions[i] is not None:
+                    companions[i] = jax.lax.psum(companions[i],
+                                                 SHARD_AXIS)
+            out_valid = (rows_per_slot > 0) & \
+                (jax.lax.axis_index(SHARD_AXIS) == 0)
+        else:
+            out_valid = rows_per_slot > 0
+        return results, companions, rows_per_slot, out_valid
+
+    def _exec_bucketed_aggregate(self, node: AggregateNode,
+                                 blk: Block) -> Block | None:
+        """Bucketed dense-grid aggregation (ops/groupby.py): the packed
+        composite slot (the same key_ranges packing the sort path
+        uses) radix-partitions into GROUP_TILE_SLOTS-wide dense tiles,
+        each reduced sort-free — no argsort over the input capacity,
+        no all_to_all combine (cross-device merge is psum/pmin/pmax
+        over the slot grid, exactly like the flat dense grid).  Stale
+        key ranges count into dense_oob and the host retries on the
+        sort path; a hot bucket overflows its static per-bucket
+        capacity and regrows through the normal retry."""
+        from ..ops.groupby import bucketed_grid_aggregate
+        from ..utils.faultinjection import fault_point
+
+        # named seam: a failure while building the bucketed pack must
+        # leave the plan cache without a half-built entry (fires at
+        # trace time, like executor.plan_cache_fill)
+        fault_point("executor.agg_bucket_fill")
+        specs = node.bucket_keys
+        total = node.bucket_total
+
+        # packed slot per row — _pack_group_keys IS the slot layout
+        # (width = extent + 1 per key, slot 0 = NULL, out-of-range
+        # values clipped but COUNTED into dense_oob so stale statistics
+        # recompile on the sort path instead of returning aliased
+        # groups); sharing the helper keeps the grid bit-identical to
+        # the sort path's packed keys on every null/oob edge case
+        key_arrays, key_meta, values = self._agg_inputs(node, blk)
+        packed, oob = self._pack_group_keys(node, key_arrays, key_meta,
+                                            blk.valid, kr=specs)
+        if packed is None:
+            # defensive: bucket_keys is one spec per group key and
+            # key_arrays/key_meta come from the same _agg_inputs walk,
+            # so the helper's shape bail-outs are statically
+            # unreachable today — this guard only matters if a future
+            # _agg_inputs change breaks that invariant
+            return None
+        self._dense_oob = self._dense_oob + oob
+        # valid rows pack to < total (clipped per key); the invalid-row
+        # int64-max sentinel is dropped by the pack's valid mask anyway
+        slot32 = jnp.clip(packed, 0, total - 1).astype(jnp.int32)
+
+        # value inputs, masked exactly like the flat dense grid:
+        # sums/counts zero under non-contribution, min/max at identity;
+        # a companion contribution count per value aggregate drives the
+        # all-NULL-group → NULL rule
+        op_values: list[tuple[jnp.ndarray, str]] = []
+        comp_idx: list[int | None] = []
+        for v, kind, vv in values:
+            contrib = blk.valid if vv is None else (blk.valid & vv)
+            if kind == "count":
+                op_values.append((contrib.astype(jnp.int32), "count"))
+                comp_idx.append(None)
+                continue
+            if kind == "sum":
+                arr = jnp.where(contrib, v, jnp.zeros((), v.dtype))
+            elif kind == "min":
+                arr = jnp.where(contrib, v, _big(v.dtype))
+            elif kind == "max":
+                arr = jnp.where(contrib, v, _small(v.dtype))
+            else:
+                raise ExecutionError(f"bad agg kind {kind}")
+            op_values.append((arr, kind))
+            comp_idx.append(len(op_values))
+            op_values.append((contrib.astype(jnp.int32), "count"))
+
+        cap = self.caps.agg_bucket[id(node)]
+        kernel = ("pallas" if self.group_kernel == "bucketed_pallas"
+                  else "xla")
+        res, rows_per_slot, boverflow, bfill = bucketed_grid_aggregate(
+            slot32, blk.valid, op_values, total, cap, kernel=kernel)
+        self._overflow = self._overflow + boverflow
+        self._record(id(node), "agg_bucket", bfill, cap)
+
+        results = []
+        companions = []
+        for i, (_v, kind, _vv) in enumerate(values):
+            pos = sum(1 for c in comp_idx[:i] if c is not None) + i
+            results.append(res[pos])
+            ci = comp_idx[i]
+            companions.append(None if ci is None else res[ci])
+
+        results, companions, rows_per_slot, out_valid = \
+            self._combine_grid(node, values, results, companions,
+                               rows_per_slot)
+        # 'agg_grid', not 'agg_out': shrinking THIS buffer means
+        # installing a real compaction pass over the slot grid, so
+        # feedback must apply the ≥3× compaction economics — the sort
+        # path's agg_out is a free slice and tightens at 0.85
+        self._record(id(node), "agg_grid",
+                     (rows_per_slot > 0).sum(), total)
+
+        # reconstruct key columns from the packed slot (first key is
+        # most significant; lane 0 of each key's width is NULL)
+        iota = jnp.arange(total, dtype=jnp.int32)
+        cols: dict[str, jnp.ndarray] = {}
+        nulls: dict[str, jnp.ndarray] = {}
+        stride = total
+        for (base, extent, _hn), (g, cid) in zip(specs, node.group_keys):
+            width = extent + 1
+            stride //= width
+            idx = (iota // stride) % width
+            cols[cid] = ((idx - 1).clip(0, extent - 1).astype(jnp.int64)
+                         + base).astype(g.dtype.numpy_dtype)
+            nulls[cid] = idx == 0
+        for i, ((_a, cid), (_v, kind, _vv)) in enumerate(
+                zip(node.aggs, values)):
+            r = results[i]
+            if kind == "count":
+                r = r.astype(jnp.int64)
+            cols[cid] = r
+            if companions[i] is not None:
+                nulls[cid] = companions[i] == 0
+        out = Block(cols, out_valid, nulls)
+
+        # high-cardinality grids are mostly empty under selective
+        # filters: compact live slots to the estimated group capacity
+        # (underestimates overflow and regrow like every static buffer)
+        k = self.caps.agg_out.get(id(node))
+        if k is not None and k < total:
+            out = self._compact(out, k)
+        return out
 
     # one-hot MXU segment-sum eligibility bound: bench_kernels.py on
     # TPU v5e measured the matmul formulation 2-10× faster than XLA's

@@ -141,14 +141,21 @@ class Executor:
         orp = plan.output_repart
         orp_sig = (None if orp is None
                    else (orp[0], orp[1], orp[2], repr(orp[3])))
+        # group_by_kernel changes which CAPACITY TABLES exist
+        # (agg_bucket vs sort-path buffers), so converged sizes memoized
+        # under one mode must not be replayed under another — it joins
+        # the fingerprint, unlike join_probe_kernel which only swaps the
+        # inner formulation at unchanged shapes
         fingerprint = (node_fingerprint(plan.root), plan.n_devices,
                        str(compute_dtype), feeds_signature(plan, feeds),
-                       topk_sig, orp_sig)
+                       topk_sig, orp_sig,
+                       self.settings.get("group_by_kernel"))
         memo = self._caps_memo.get(fingerprint)
         caps = (self._caps_from_order(plan, memo) if memo is not None
                 else self._initial_capacities(plan, feeds))
         packed, out_meta, caps, retries = self.run_with_retry(
             plan, feeds, caps, fingerprint, compute_dtype)
+        self.count_groupby_bucketed(plan, caps)
         cols, nulls, valid = unpack_outputs(packed, out_meta)
         result = self._host_combine(plan, cols, nulls, valid, raw)
         result.retries = retries
@@ -193,6 +200,10 @@ class Executor:
                         "or extreme-fanout join; rewrite the query or "
                         "raise the limit")
             probe_kernel = self.settings.get("join_probe_kernel")
+            # group_by_kernel already rides in `fingerprint` (it shapes
+            # the capacity tables); probe_kernel only swaps the inner
+            # formulation so it joins the key here
+            group_kernel = self.settings.get("group_by_kernel")
             key = fingerprint + (caps_signature(plan, caps), probe_kernel)
             entry = self.plan_cache.get(key)
             if entry is None:
@@ -203,7 +214,8 @@ class Executor:
                 fault_point("executor.plan_cache_fill")
                 compiler = PlanCompiler(plan, self.mesh, feeds, caps,
                                         compute_dtype,
-                                        probe_kernel=probe_kernel)
+                                        probe_kernel=probe_kernel,
+                                        group_kernel=group_kernel)
                 fn, feed_arrays, out_meta, stage_keys = compiler.build()
                 self.plan_cache.put(key, (fn, out_meta, stage_keys))
             else:
@@ -280,12 +292,35 @@ class Executor:
                     output_repart=max(fresh.output_repart or 0,
                                       caps.output_repart or 0) or None,
                     bucket_probe={k: max(v, caps.bucket_probe.get(k, 0))
-                                  for k, v in fresh.bucket_probe.items()})
+                                  for k, v in fresh.bucket_probe.items()},
+                    agg_bucket={k: max(v, caps.agg_bucket.get(k, 0))
+                                for k, v in fresh.agg_bucket.items()})
             if cap_overflow:
                 caps = caps.grown(cap_overflow)
 
     # ------------------------------------------------------------------
-    CAPS_MEMO_VERSION = 5  # bump when capacity semantics change
+    def count_groupby_bucketed(self, plan: QueryPlan,
+                               caps: Capacities) -> None:
+        """groupby_bucketed_total: bump once per executed STATEMENT
+        whose converged plan ran the bucketed dense-grid group-by —
+        callers invoke this after their retry loop settles (the
+        streamed path calls it once after the batch loop, not per
+        batch), and a dense_oob fallback onto the sort path
+        (caps.dense_off) correctly counts nothing."""
+        if self.counters is None:
+            return
+        from ..stats import counters as sc
+
+        group_kernel = self.settings.get("group_by_kernel")
+        nbk = sum(1 for nd in walk_plan(plan.root)
+                  if isinstance(nd, AggregateNode)
+                  and PlanCompiler.agg_bucket_shape(
+                      nd, group_kernel, caps.dense_off))
+        if nbk:
+            self.counters.increment(sc.GROUPBY_BUCKETED_TOTAL, nbk)
+
+    # ------------------------------------------------------------------
+    CAPS_MEMO_VERSION = 6  # bump when capacity semantics change
 
     def _memo_path(self) -> str:
         import os
@@ -364,9 +399,13 @@ class Executor:
     # ~80M elem/s (bench_kernels), so a 60M→42M "win" measured 2.5 s
     # SLOWER on Q3 SF10.  Compaction must shrink ≥3× to pay for itself.
     TIGHTEN_SLACK = 1.3
+    # agg_grid = the bucketed grid's live-group count: it shares the
+    # agg_out capacity table but shrinking it INSTALLS a compaction
+    # pass over the slot grid, so it pays the compaction economics
     TIGHTEN_THRESHOLD = {"repartition": 0.85, "agg_out": 0.85,
-                         "bucket_probe": 0.85,
-                         "scan_out": 1.0 / 3.0, "join_out": 1.0 / 3.0}
+                         "bucket_probe": 0.85, "agg_bucket": 0.85,
+                         "scan_out": 1.0 / 3.0, "join_out": 1.0 / 3.0,
+                         "agg_grid": 1.0 / 3.0}
 
     def _tighten_caps(self, plan: QueryPlan, caps: Capacities,
                       stage_keys, actuals) -> Capacities | None:
@@ -381,13 +420,14 @@ class Executor:
                "join_out": dict(caps.join_out),
                "agg_out": dict(caps.agg_out),
                "scan_out": dict(caps.scan_out),
-               "bucket_probe": dict(caps.bucket_probe)}
+               "bucket_probe": dict(caps.bucket_probe),
+               "agg_bucket": dict(caps.agg_bucket)}
         changed = False
         for (widx, kind, width), actual in zip(stage_keys, actuals):
             nid = rev.get(widx)
             if nid is None:
                 continue
-            table = new[kind]
+            table = new["agg_out" if kind == "agg_grid" else kind]
             cur = table.get(nid, width)
             t = _round_cap(int(int(actual) * self.TIGHTEN_SLACK) + 128)
             if t < cur * self.TIGHTEN_THRESHOLD[kind]:
@@ -398,7 +438,7 @@ class Executor:
         return Capacities(new["repartition"], new["join_out"],
                           new["agg_out"], caps.dense_off,
                           new["scan_out"], caps.output_repart,
-                          new["bucket_probe"])
+                          new["bucket_probe"], new["agg_bucket"])
 
     # ------------------------------------------------------------------
     @staticmethod
@@ -414,7 +454,8 @@ class Executor:
                 caps.dense_off,
                 {order[k]: v for k, v in caps.scan_out.items()},
                 caps.output_repart,
-                {order[k]: v for k, v in caps.bucket_probe.items()})
+                {order[k]: v for k, v in caps.bucket_probe.items()},
+                {order[k]: v for k, v in caps.agg_bucket.items()})
 
     @staticmethod
     def _caps_from_order(plan: QueryPlan, memo: tuple) -> Capacities:
@@ -428,7 +469,9 @@ class Executor:
                           {rev[i]: v for i, v in memo[4].items()},
                           memo[5] if len(memo) > 5 else None,
                           {rev[i]: v for i, v in memo[6].items()}
-                          if len(memo) > 6 else None)
+                          if len(memo) > 6 else None,
+                          {rev[i]: v for i, v in memo[7].items()}
+                          if len(memo) > 7 else None)
 
     def _initial_capacities(self, plan: QueryPlan, feeds,
                             dense_off: bool = False) -> Capacities:
@@ -437,12 +480,15 @@ class Executor:
         join_factor = self.settings.get("join_output_capacity_factor")
         group_factor = self.settings.get("agg_group_capacity_factor")
         bucket_factor = self.settings.get("join_probe_bucket_factor")
+        agg_bucket_factor = self.settings.get("agg_bucket_capacity_factor")
+        group_kernel = self.settings.get("group_by_kernel")
         n_dev = plan.n_devices
         repart: dict[int, int] = {}
         join_out: dict[int, int] = {}
         agg_out: dict[int, int] = {}
         scan_out: dict[int, int] = {}
         bucket_probe: dict[int, int] = {}
+        agg_bucket: dict[int, int] = {}
 
         def cap_of(node, skip_emit: bool = False) -> int:
             """skip_emit: the node's OWN output buffer is never
@@ -570,6 +616,29 @@ class Executor:
                 if node.dense_keys is not None and not dense_off and \
                         node.combine in ("local", "repartition"):
                     return node.dense_total  # fixed dense-grid output
+                if PlanCompiler.agg_bucket_shape(node, group_kernel,
+                                                 dense_off):
+                    # bucketed dense grid: the packed input buffer is
+                    # [n_buckets, cap] at the uniform expectation ×
+                    # skew headroom (a hot bucket overflows and
+                    # regrows; feedback tightens converged sizes), and
+                    # the [bucket_total] output grid compacts to the
+                    # estimated group count under the same ≥3×
+                    # economics as every compaction pass
+                    from ..ops.groupby import group_bucket_count
+
+                    nb = group_bucket_count(node.bucket_total)
+                    agg_bucket[id(node)] = _round_cap(
+                        int(-(-in_cap // nb) * agg_bucket_factor) + 128)
+                    out = node.bucket_total
+                    est_g = node.est_groups
+                    if est_g:
+                        k = _round_cap(
+                            min(out, int(est_g * group_factor) + 16))
+                        if k * 3 < out:
+                            agg_out[id(node)] = k
+                            out = k
+                    return out
                 est_g = node.est_groups
                 if est_g:
                     # group-count estimate bounds every aggregate buffer:
@@ -596,7 +665,7 @@ class Executor:
             out_rp = _round_cap(
                 int(-(-root_cap // n_dev) * repart_factor) + 256)
         return Capacities(repart, join_out, agg_out, dense_off, scan_out,
-                          out_rp, bucket_probe)
+                          out_rp, bucket_probe, agg_bucket)
 
     # ------------------------------------------------------------------
     def _host_combine(self, plan: QueryPlan, cols, nulls, valid,
@@ -749,6 +818,23 @@ def _plan_buffer_bytes(plan: QueryPlan, caps: Capacities) -> int:
 
             nb = probe_bucket_count(int(ext[0][1]))
             worst = max(worst, cap * nb * 3 * 4 * plan.n_devices)
+    for nid, cap in caps.agg_bucket.items():
+        # bucketed group-by: the [n_buckets, cap] pack per value column
+        # (int64-worst, per device — the hot-bucket regrow path, same
+        # skew-explosion exposure as the probe pack above) AND the
+        # [bucket_total]-slot result grid (results + companions + key
+        # reconstruction), which at the 2^24 slot cap is the largest
+        # buffer this path allocates when no agg_out compaction applies
+        node = nodes.get(nid)
+        total = getattr(node, "bucket_total", 0) if node is not None else 0
+        if total:
+            from ..ops.groupby import group_bucket_count
+
+            nb = group_bucket_count(total)
+            ncols = len(node.out_columns) if node is not None else 4
+            worst = max(worst,
+                        cap * nb * (ncols + 2) * 8 * plan.n_devices,
+                        total * (ncols + 2) * 8 * plan.n_devices)
     return worst
 
 

@@ -515,7 +515,8 @@ def try_execute_streamed(executor, plan: QueryPlan, raw: bool):
                 fingerprint = ("stream", batch_cap,
                                node_fingerprint(plan.root), n_dev,
                                str(compute_dtype),
-                               feeds_signature(plan, feeds), topk_sig)
+                               feeds_signature(plan, feeds), topk_sig,
+                               executor.settings.get("group_by_kernel"))
                 memo = executor._caps_memo.get(fingerprint)
                 caps = (executor._caps_from_order(plan, memo)
                         if memo is not None
@@ -559,6 +560,10 @@ def try_execute_streamed(executor, plan: QueryPlan, raw: bool):
         from ..stats.counters import QUERIES_STREAMED
 
         executor.counters.increment(QUERIES_STREAMED)
+    if caps is not None:
+        # once per STATEMENT, after the batch loop (run_with_retry runs
+        # per batch and must not inflate the statement-level counter)
+        executor.count_groupby_bucketed(plan, caps)
     return result
 
 

@@ -1,4 +1,9 @@
 from .aggregate import distinct, segment_aggregate
+from .groupby import (
+    bucketed_grid_aggregate,
+    group_bucket_count,
+    group_bucket_eligible,
+)
 from .hashing import (
     combine_hash64,
     fmix32_jax,
@@ -20,7 +25,10 @@ from .join import (
 from .partition import pack_by_target
 
 __all__ = [
-    "distinct", "segment_aggregate", "combine_hash64", "fmix32_jax",
+    "distinct", "segment_aggregate",
+    "bucketed_grid_aggregate", "group_bucket_count",
+    "group_bucket_eligible",
+    "combine_hash64", "fmix32_jax",
     "hash_token_jax", "shard_index_for_values_jax", "shard_index_from_token",
     "tile_buckets",
     "bucketed_unique_lookup", "dense_unique_lookup",

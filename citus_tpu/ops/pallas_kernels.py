@@ -165,6 +165,105 @@ if _PALLAS_OK:
         return out[:, :cap]
 
 
+if _PALLAS_OK:
+
+    def _groupby_kernel(slot_ref, val_ref, out_ref, acc_ref, *,
+                        n_chunks: int):
+        """One grid step: accumulate ROW_TILE packed rows of bucket b
+        into that bucket's [tile, A] VMEM scratch.  The grid is
+        (bucket, row chunk) with the row dimension fastest, so each
+        bucket's chunks run back-to-back and the scratch accumulation
+        is safe (TPU grid steps are sequential on one core)."""
+        r = pl.program_id(1)
+
+        @pl.when(r == 0)
+        def _init():
+            acc_ref[:] = jnp.zeros_like(acc_ref)
+
+        slots = slot_ref[:]                       # [T, 1] int32
+        vals = val_ref[:]                         # [T, A] f32
+        for c in range(n_chunks):
+            base = c * K_CHUNK
+            ids = jax.lax.broadcasted_iota(
+                jnp.int32, (ROW_TILE, K_CHUNK), 1) + base
+            onehot = (slots == ids).astype(jnp.float32)
+            part = jax.lax.dot_general(
+                onehot, vals,
+                dimension_numbers=(((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)        # [Kc, A]
+            sl = pl.ds(base, K_CHUNK)
+            acc_ref[sl, :] = acc_ref[sl, :] + part
+
+        @pl.when(r == pl.num_programs(1) - 1)
+        def _flush():
+            out_ref[:] = acc_ref[:]
+
+    @functools.partial(jax.jit, static_argnames=("tile", "interpret"))
+    def bucketed_groupby_sums_pallas(loc2d: jnp.ndarray,
+                                     stack: jnp.ndarray, tile: int,
+                                     interpret: bool = False
+                                     ) -> jnp.ndarray:
+        """Bucket-tiled MXU segment-sum for the bucketed group-by path.
+
+        loc2d [n_buckets, cap] int32 — tile-local slots packed by
+        bucket (garbage lanes hold slot 0 with ZEROED values, as
+        pack_by_target emits them, so they contribute exact zeros);
+        stack [n_buckets, cap, A] f32 — value columns, same packing.
+        Returns [n_buckets, tile, A] f32 per-tile sums.
+
+        The same one-hot-matmul-in-VMEM-scratch algorithm as
+        dense_grid_aggregate_pallas, batched over buckets: grid =
+        (bucket, row chunk), scratch [tile, A] lives across a bucket's
+        row chunks.  Whether this beats the batched-XLA one-hot
+        dot_general on real hardware is bench_kernels.py groupby's
+        call — the executor routes through XLA unless the measurement
+        (group_by_kernel config var) says otherwise."""
+        nb, cap = loc2d.shape
+        a = stack.shape[2]
+        cap_pad = _round_up(max(cap, ROW_TILE), ROW_TILE)
+        k_pad = _round_up(tile, K_CHUNK)
+        a_pad = _round_up(a, 128)
+        row_steps = cap_pad // ROW_TILE
+
+        slot_flat = jnp.zeros((nb * cap_pad, 1), jnp.int32)
+        slot_flat = slot_flat.reshape(nb, cap_pad, 1).at[:, :cap, 0].set(
+            loc2d).reshape(nb * cap_pad, 1)
+        val_flat = jnp.zeros((nb * cap_pad, a_pad), jnp.float32) \
+            .reshape(nb, cap_pad, a_pad).at[:, :cap, :a].set(
+            stack.astype(jnp.float32)).reshape(nb * cap_pad, a_pad)
+
+        kernel = functools.partial(_groupby_kernel,
+                                   n_chunks=k_pad // K_CHUNK)
+        out = pl.pallas_call(
+            kernel,
+            grid=(nb, row_steps),
+            in_specs=[
+                pl.BlockSpec((ROW_TILE, 1),
+                             lambda b, r: (b * row_steps + r, 0)),
+                pl.BlockSpec((ROW_TILE, a_pad),
+                             lambda b, r: (b * row_steps + r, 0)),
+            ],
+            out_specs=pl.BlockSpec((k_pad, a_pad), lambda b, r: (b, 0)),
+            out_shape=jax.ShapeDtypeStruct((nb * k_pad, a_pad),
+                                           jnp.float32),
+            scratch_shapes=[pltpu.VMEM((k_pad, a_pad), jnp.float32)],
+            interpret=interpret,
+        )(slot_flat, val_flat)
+        return out.reshape(nb, k_pad, a_pad)[:, :tile, :a]
+
+
+def groupby_sums_reference(loc2d: np.ndarray, stack: np.ndarray,
+                           tile: int) -> np.ndarray:
+    """numpy oracle for the bucket-tiled segment sum."""
+    nb, cap = np.asarray(loc2d).shape
+    a = np.asarray(stack).shape[2]
+    out = np.zeros((nb, tile, a), np.float32)
+    for b in range(nb):
+        np.add.at(out[b], np.asarray(loc2d)[b],
+                  np.asarray(stack)[b].astype(np.float32))
+    return out
+
+
 def probe_gather_reference(dir2d: np.ndarray,
                            loc2d: np.ndarray) -> np.ndarray:
     """numpy oracle for the tiled probe gather."""

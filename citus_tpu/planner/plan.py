@@ -170,6 +170,22 @@ class AggregateNode(PlanNode):
     # the dedupe level by the outer GROUP BY keys alone so the
     # re-aggregation level stays device-local
     repart_keys: Optional[tuple[int, ...]] = None
+    # bucketed dense-grid aggregation (ops/groupby.py): the packed key
+    # space is ABOVE the dense grid's slot cap but small/occupied
+    # enough to radix-partition into GROUP_TILE_SLOTS-wide tiles and
+    # reduce sort-free — the aggregation twin of the bucketed join
+    # probe.  Entries mirror key_ranges ((base, extent, has_null) per
+    # key; the slot always reserves the null lane, so bucket_total is
+    # the product of extent+1).  Stale ranges retry via dense_oob.
+    bucket_keys: Optional[tuple[tuple[int, int, bool], ...]] = None
+    bucket_total: int = 0
+    # the planner's measurement-gated pick for group_by_kernel='auto':
+    # True only on TPU backends, where the pack's argsort buys sort
+    # elimination that measures as a win (bench_kernels.py groupby) —
+    # on XLA:CPU the sort IS the wall, so auto keeps the sort path.
+    # group_by_kernel='bucketed'/'bucketed_pallas' overrides the gate
+    # wherever bucket_keys is structurally set.
+    group_bucketed: bool = False
 
 
 @dataclass
@@ -1378,6 +1394,24 @@ class DistributedPlanner:
         if total <= self.DENSE_GROUP_LIMIT:
             node.dense_keys = tuple(specs)
             node.dense_total = total
+        elif pack_total <= self.PACK_SLOT_LIMIT:
+            # past the dense grid's cap: the bucketed grid
+            # (ops/groupby.py) radix-partitions the packed slot space
+            # into dense tiles.  Structural eligibility (annotated so
+            # group_by_kernel can force the path on any backend) needs
+            # the slot space materializable and occupied; the AUTO pick
+            # is additionally TPU-gated — spending a pack argsort to
+            # skip the group sort only pays where sorts are the
+            # measured wall (bench_kernels.py groupby)
+            import jax
+
+            from ..ops.groupby import group_bucket_eligible
+
+            if group_bucket_eligible(pack_total,
+                                     node.input.est_rows):
+                node.bucket_keys = tuple(specs)
+                node.bucket_total = pack_total
+                node.group_bucketed = jax.default_backend() == "tpu"
 
     def _column_nullable(self, col: ir.BCol) -> bool:
         try:

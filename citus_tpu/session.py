@@ -192,6 +192,13 @@ class Session:
             t0 = _time.perf_counter()
             for stmt in parse(sql):
                 activity.retries = 0
+                # per-STATEMENT snapshot (like the retries reset): the
+                # citus_stat_activity cache columns show the in-flight
+                # statement's own traffic, not the whole script's
+                activity.cache_base = (self.executor.plan_cache.hits,
+                                       self.executor.plan_cache.misses,
+                                       self.executor.feed_cache.hits,
+                                       self.executor.feed_cache.misses)
                 result = self._execute_resilient(stmt, activity)
                 self._count_statement(stmt, result)
                 tenant_hits.extend(extract_tenants(stmt, self.catalog))
@@ -744,12 +751,32 @@ class Session:
                                    for s in entries]}, len(entries))
         elif e.name == "citus_stat_activity":
             entries = self.stats.activity.entries()
+            # per-statement cache activity: live executor totals minus
+            # the snapshot taken when the statement started (0 for
+            # entries tracked before a baseline existed)
+            live = (self.executor.plan_cache.hits,
+                    self.executor.plan_cache.misses,
+                    self.executor.feed_cache.hits,
+                    self.executor.feed_cache.misses)
+
+            def delta(a, i):
+                if a.cache_base is None:
+                    return 0
+                return max(0, live[i] - a.cache_base[i])
+
             return ResultSet(
-                ["global_pid", "query", "state", "retries"],
+                ["global_pid", "query", "state", "retries",
+                 "plan_cache_hits", "plan_cache_misses",
+                 "feed_cache_hits", "feed_cache_misses"],
                 {"global_pid": [a.gpid for a in entries],
                  "query": [a.query for a in entries],
                  "state": [a.state for a in entries],
-                 "retries": [a.retries for a in entries]}, len(entries))
+                 "retries": [a.retries for a in entries],
+                 "plan_cache_hits": [delta(a, 0) for a in entries],
+                 "plan_cache_misses": [delta(a, 1) for a in entries],
+                 "feed_cache_hits": [delta(a, 2) for a in entries],
+                 "feed_cache_misses": [delta(a, 3) for a in entries]},
+                len(entries))
         elif e.name == "get_rebalance_progress":
             mons = self.stats.progress.all()
             return ResultSet(
@@ -1191,6 +1218,8 @@ class Session:
 
                 snap0 = self.stats.counters.snapshot()
                 skipped0 = snap0.get(sc.CHUNKS_SKIPPED, 0)
+                pc, fc = self.executor.plan_cache, self.executor.feed_cache
+                cache0 = (pc.hits, pc.misses, fc.hits, fc.misses)
                 t0 = time.perf_counter()
                 result = self.executor.execute_plan(plan)
                 elapsed = time.perf_counter() - t0
@@ -1225,6 +1254,18 @@ class Session:
                     f"{snap.get(sc.TIMEOUTS_TOTAL, 0)} "
                     "faults_injected_total="
                     f"{snap.get(sc.FAULTS_INJECTED_TOTAL, 0)})")
+                # this statement's plan/feed-cache traffic (the
+                # counters live on PlanCache/FeedCache; deltas follow
+                # the Chunks Skipped pattern), plus session totals so
+                # warm-vs-cold is auditable from one EXPLAIN ANALYZE
+                lines.append(
+                    "Caches: plan-cache hits="
+                    f"{pc.hits - cache0[0]} misses="
+                    f"{pc.misses - cache0[1]}  feed-cache hits="
+                    f"{fc.hits - cache0[2]} misses="
+                    f"{fc.misses - cache0[3]} (session totals: plan "
+                    f"{pc.hits}/{pc.misses}, feed {fc.hits}/{fc.misses}"
+                    " hits/misses)")
             return ResultSet(["QUERY PLAN"], {"QUERY PLAN": lines},
                              len(lines))
         finally:

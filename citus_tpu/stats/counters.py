@@ -35,6 +35,9 @@ INSERT_SELECT_REPARTITION = "insert_select_repartition"
 INSERT_SELECT_PULL = "insert_select_pull"
 CHUNKS_SKIPPED = "chunks_skipped"
 QUERIES_STREAMED = "queries_streamed"
+# statements whose plan executed the bucketed dense-grid group-by
+# (ops/groupby.py) instead of the sort path
+GROUPBY_BUCKETED_TOTAL = "groupby_bucketed_total"
 # resilient statement execution (session retry loop / deadline seams)
 RETRIES_TOTAL = "retries_total"
 FAILOVERS_TOTAL = "failovers_total"
@@ -49,7 +52,7 @@ ALL_COUNTERS = [
     DML_UPDATE, DML_DELETE, DML_MERGE, DDL_COMMANDS,
     CAPACITY_RETRIES, DEVICE_ROWS_SCANNED,
     INSERT_SELECT_PUSHDOWN, INSERT_SELECT_REPARTITION, INSERT_SELECT_PULL,
-    CHUNKS_SKIPPED, QUERIES_STREAMED,
+    CHUNKS_SKIPPED, QUERIES_STREAMED, GROUPBY_BUCKETED_TOTAL,
     RETRIES_TOTAL, FAILOVERS_TOTAL, TIMEOUTS_TOTAL, QUERIES_CANCELED,
     FAULTS_INJECTED_TOTAL,
 ]

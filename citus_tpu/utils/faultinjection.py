@@ -63,6 +63,8 @@ FAULT_POINTS: dict[str, str] = {
     "store.read_shard": "storage/table_store.py — shard stripe read",
     "executor.overflow_retry": "executor/runner.py — capacity regrow",
     "executor.plan_cache_fill": "executor/runner.py — compiled-plan insert",
+    "executor.agg_bucket_fill":
+        "executor/compiler.py — bucketed group-by pack",
     "executor.device_put": "executor/feed.py — host→HBM placement",
     "executor.repartition_shuffle":
         "executor/insert_select.py — INSERT..SELECT repartition write",

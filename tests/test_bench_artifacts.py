@@ -1,0 +1,134 @@
+"""Bench-artifact honesty check (VERDICT r5): the README headline table
+must quote EXACTLY the newest driver-captured BENCH_r*.json numbers —
+never a hotter hand-picked sample.  Tier-1: runs on every commit, skips
+cleanly when no bench artifact is present (fresh clones, CI without
+driver captures)."""
+
+import glob
+import json
+import os
+import re
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# README table row label → (SF1 metric, SF10 metric)
+TABLE_METRICS = {
+    "TPC-H Q1": ("tpch_q1_rows_per_sec", None),
+    "TPC-H Q3": ("tpch_q3_rows_per_sec", "tpch_q3_sf10_rows_per_sec"),
+    "dual-repartition join": ("dual_repartition_join_rows_per_sec",
+                              "dual_repartition_join_sf10_rows_per_sec"),
+    "single-repartition join": (
+        "single_repartition_join_rows_per_sec",
+        "single_repartition_join_sf10_rows_per_sec"),
+    "co-located join": ("colocated_join_rows_per_sec", None),
+}
+
+
+def _newest_artifact():
+    paths = sorted(glob.glob(os.path.join(ROOT, "BENCH_r*.json")))
+    return paths[-1] if paths else None
+
+
+def _artifact_metrics(path):
+    """metric → line dict, parsed from the driver capture's JSON-lines
+    tail (the artifact wraps the run's stdout)."""
+    with open(path) as f:
+        doc = json.load(f)
+    out = {}
+    for line in doc.get("tail", "").splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            obj = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if "metric" in obj:
+            out[obj["metric"]] = obj
+    return out
+
+
+def _readme_table_rows():
+    """label → (sf1 cell, sf10 cell) from the README headline table."""
+    with open(os.path.join(ROOT, "README.md")) as f:
+        text = f.read()
+    m = re.search(r"\| config \| SF1 \| SF10 \|\n\|[-| ]+\|\n"
+                  r"((?:\|.*\|\n)+)", text)
+    assert m, "README headline table (| config | SF1 | SF10 |) missing"
+    rows = {}
+    for line in m.group(1).strip().splitlines():
+        cells = [c.strip() for c in line.strip().strip("|").split("|")]
+        assert len(cells) == 3, f"malformed README bench row: {line!r}"
+        rows[cells[0]] = (cells[1], cells[2])
+    return rows
+
+
+def _quoted_multiplier(cell):
+    """First N.N× multiplier quoted in a table cell (None for '—')."""
+    m = re.search(r"(\d+(?:\.\d+)?)×", cell)
+    return None if m is None else m.group(1)
+
+
+def _quoted_cpu_multiplier(cell):
+    m = re.search(r"(\d+)× measured CPU", cell)
+    return None if m is None else int(m.group(1))
+
+
+@pytest.fixture(scope="module")
+def artifact():
+    path = _newest_artifact()
+    if path is None:
+        pytest.skip("no BENCH_r*.json driver capture present")
+    return path
+
+
+def test_readme_names_the_newest_artifact(artifact):
+    with open(os.path.join(ROOT, "README.md")) as f:
+        text = f.read()
+    name = os.path.basename(artifact)
+    assert name.replace(".json", "") in text, (
+        f"README bench section must cite the newest driver capture "
+        f"({name}), not an older one")
+
+
+def test_readme_table_matches_newest_artifact(artifact):
+    metrics = _artifact_metrics(artifact)
+    assert metrics, f"{artifact} has no parseable JSON metric lines"
+    rows = _readme_table_rows()
+    assert set(rows) == set(TABLE_METRICS), (
+        "README table rows drifted from the audited metric map; "
+        "update TABLE_METRICS with the new row")
+    mismatches = []
+    for label, (m1, m10) in TABLE_METRICS.items():
+        for cell, metric in zip(rows[label], (m1, m10)):
+            quoted = _quoted_multiplier(cell)
+            if metric is None:
+                if quoted is not None:
+                    mismatches.append(
+                        f"{label}: quotes {quoted}× but no artifact "
+                        "metric is mapped for that column")
+                continue
+            line = metrics.get(metric)
+            if line is None:
+                mismatches.append(
+                    f"{label}: artifact lacks {metric} but the README "
+                    f"quotes {quoted}×")
+                continue
+            want = f"{line['vs_baseline']:.1f}"
+            if quoted != want:
+                mismatches.append(
+                    f"{label}: README quotes {quoted}× but "
+                    f"{os.path.basename(artifact)} says "
+                    f"{want}× ({metric})")
+            cpu_quoted = _quoted_cpu_multiplier(cell)
+            if cpu_quoted is not None:
+                vs_cpu = line.get("vs_cpu")
+                if vs_cpu is None or round(vs_cpu) != cpu_quoted:
+                    mismatches.append(
+                        f"{label}: README quotes {cpu_quoted}× "
+                        f"measured CPU but the artifact says "
+                        f"{vs_cpu} ({metric})")
+    assert not mismatches, "README bench table is stale:\n  " + \
+        "\n  ".join(mismatches)

@@ -1,0 +1,39 @@
+"""bench_kernels.py harness smoke tests: one tiny shape per
+subcommand, so the A/B harnesses can't silently rot while the full
+runs stay reserved for real hardware.  slow-marked like the probe
+smoke in test_ops — microbench compiles have no place in the tier-1
+budget (the full runs are what the driver captures on a chip)."""
+
+import pathlib
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+root = pathlib.Path(__file__).resolve().parent.parent
+if str(root) not in sys.path:
+    sys.path.insert(0, str(root))
+
+
+def test_groupby_harness_smoke():
+    """`python bench_kernels.py groupby` at a toy shape: the table
+    prints, and the sort-vs-bucketed correctness gate holds."""
+    import bench_kernels
+
+    rows = bench_kernels.bench_groupby(
+        regimes=[(1 << 13, 512)], repeats=1, reps=2)
+    assert len(rows) == 1
+    assert rows[0][-1] is True  # sort vs bucketed parity gate
+
+
+def test_dense_aggregate_harness_smoke():
+    """The default (dense segment-aggregation) A/B at a toy shape:
+    all three formulations produce a timing row and the pallas
+    correctness flag holds."""
+    import bench_kernels
+
+    rows = bench_kernels.main(regimes=[(1 << 12, 64)])
+    assert len(rows) == 1
+    n, k, t_seg, t_oh, _t_pl, ok = rows[0]
+    assert t_seg > 0 and t_oh > 0 and ok

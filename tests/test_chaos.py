@@ -45,6 +45,7 @@ FAULT_POOL = [
     dict(name="store.apply_dml"),
     dict(name="executor.device_put"),
     dict(name="executor.plan_cache_fill"),
+    dict(name="executor.agg_bucket_fill"),
     dict(name="executor.repartition_shuffle"),
     dict(name="catalog.placement_probe"),
     dict(name="stream.prefetch"),
