@@ -29,6 +29,10 @@ driver-captured); its ingest caches in .benchdata/bench_sf10 so only
 the first run pays the ~14 min single-core generation, and the budget
 check skips the section rather than truncating the run.
 
+`python bench.py concurrency` runs the workload-manager A/B instead
+(bench_concurrency: N concurrent mixed-tenant sessions, admission gate
+off vs on, rows/sec + p50/p99 queue wait — PERF_NOTES round 8).
+
 Env knobs: BENCH_SF (default 1.0), BENCH_REPEATS (default 3),
 BENCH_REPEAT (best-of-N authority: forces EVERY config — the SF10
 section's reduced repeat counts included — to at least N measured
@@ -119,7 +123,111 @@ def bench_cold_scan(sess, n_rows: int):
     return bytes_scanned / best / 1e9, best, parts, reps
 
 
+def bench_concurrency() -> None:
+    """`python bench.py concurrency` — concurrent-throughput A/B for the
+    workload manager (PERF_NOTES round 8): N worker sessions over one
+    data_dir run an identical mixed-tenant statement stream twice, with
+    the admission gate off then on (`wlm_enabled`, 2 slots), printing
+    one JSON line per mode with aggregate rows/sec and the p50/p99
+    admission queue wait.  Knobs: BENCH_CONC_WORKERS (default 4),
+    BENCH_CONC_ITERS (statements per worker, default 10), BENCH_SF
+    (default 0.05 — the scenario measures scheduling, not scan speed)."""
+    import threading
+
+    from citus_tpu.ingest.tpch import load_into_session
+    from citus_tpu.session import Session
+
+    n_workers = int(os.environ.get("BENCH_CONC_WORKERS", "4"))
+    n_iters = int(os.environ.get("BENCH_CONC_ITERS", "10"))
+    sf = float(os.environ.get("BENCH_SF", "0.05"))
+    data_dir = tempfile.mkdtemp(prefix="citus_tpu_conc_")
+    try:
+        seed_sess = Session(data_dir=data_dir)
+        counts = load_into_session(seed_sess, sf=sf, seed=0,
+                                   tables={"orders", "lineitem"})
+        n_li = counts["lineitem"]
+        n_ord = counts["orders"]
+        # per-iteration statement mix: a grouped scan-agg, a colocated
+        # join, and a fast-path point read (exempt — rides free)
+        mix = [
+            ("select l_returnflag, count(*), sum(l_quantity) "
+             "from lineitem group by l_returnflag", n_li),
+            ("select count(*), sum(l_extendedprice) from orders, "
+             "lineitem where o_orderkey = l_orderkey", n_ord + n_li),
+            ("select o_totalprice from orders where o_orderkey = 1", 1),
+        ]
+
+        def run_mode(wlm_on: bool):
+            sessions = [Session(
+                data_dir=data_dir, wlm_enabled=wlm_on,
+                max_concurrent_statements=2,
+                wlm_tenant=f"tenant{i % 2}",
+                wlm_tenant_weights="tenant0:3,tenant1:1",
+                wlm_default_priority="interactive" if i % 2 == 0
+                else "batch")
+                for i in range(n_workers)]
+            for s in sessions:  # warm every plan cache off the clock
+                for sql, _ in mix:
+                    s.execute(sql)
+            waits: list[float] = []
+            waits_lock = threading.Lock()
+            rows_done = [0] * n_workers
+
+            def worker(i, s):
+                local_waits = []
+                for it in range(n_iters):
+                    for sql, rows in mix:
+                        s.execute(sql)
+                        rows_done[i] += rows
+                        info = getattr(s._wlm_tls, "last", None)
+                        if info is not None:
+                            local_waits.append(info["queued_ms"])
+                with waits_lock:
+                    waits.extend(local_waits)
+
+            threads = [threading.Thread(target=worker, args=(i, s))
+                       for i, s in enumerate(sessions)]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            elapsed = time.perf_counter() - t0
+            for s in sessions:
+                s.close()
+            waits.sort()
+
+            def pct(p):
+                return (round(waits[min(len(waits) - 1,
+                                        int(p * len(waits)))], 2)
+                        if waits else 0.0)
+
+            return {
+                "metric": "concurrency_rows_per_sec_wlm_"
+                          + ("on" if wlm_on else "off"),
+                "value": round(sum(rows_done) / elapsed, 1),
+                "unit": "rows/s",
+                "seconds": round(elapsed, 4),
+                "sf": sf,
+                "workers": n_workers,
+                "iters": n_iters,
+                "slots": 2 if wlm_on else None,
+                "statements": n_workers * n_iters * len(mix),
+                "p50_queue_wait_ms": pct(0.50),
+                "p99_queue_wait_ms": pct(0.99),
+            }
+
+        seed_sess.close()
+        for wlm_on in (False, True):
+            print(json.dumps(run_mode(wlm_on)), flush=True)
+    finally:
+        shutil.rmtree(data_dir, ignore_errors=True)
+
+
 def main() -> None:
+    if sys.argv[1:2] == ["concurrency"]:
+        bench_concurrency()
+        return
     sf = float(os.environ.get("BENCH_SF", "1.0"))
     repeats = int(os.environ.get("BENCH_REPEATS", "3"))
     # BENCH_REPEAT=N: best-of-N authority — every config (SF10 lines

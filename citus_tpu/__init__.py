@@ -16,6 +16,7 @@ rebuilt TPU-first:
 
 from .config import Settings, registered_vars
 from .errors import (
+    AdmissionRejected,
     CapacityOverflowError,
     CatalogError,
     CitusTpuError,
@@ -40,6 +41,7 @@ __all__ = [
     "StorageError", "ParseError", "PlanningError", "UnsupportedQueryError",
     "ExecutionError", "CapacityOverflowError", "IngestError",
     "TransactionError", "QueryCanceled", "StatementTimeout",
+    "AdmissionRejected",
     "__version__",
 ]
 

@@ -66,10 +66,20 @@ class BackgroundJob:
 
 
 class BackgroundJobRunner:
-    """Bounded worker pool executing task DAGs."""
+    """Bounded worker pool executing task DAGs.
 
-    def __init__(self, max_executors: int = 4):
+    When a workload manager is attached, every task execution first
+    admits at `background` priority (wlm/manager.py) — rebalance moves
+    and maintenance jobs wait for capacity behind user statements
+    instead of racing them for the device (the reference runs
+    background tasks under their own executor caps for the same
+    reason, citus.max_background_task_executors_per_node)."""
+
+    def __init__(self, max_executors: int = 4, wlm=None,
+                 wlm_request=None):
         self.max_executors = max_executors
+        self._wlm = wlm
+        self._wlm_request = wlm_request if wlm is not None else None
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         self._jobs: dict[int, BackgroundJob] = {}
@@ -135,7 +145,18 @@ class BackgroundJobRunner:
                 if self._stop:
                     return
             try:
-                task.result = task.fn()
+                ticket = None
+                if self._wlm_request is not None:
+                    # background-class admission: waits for a free slot
+                    # (unbounded queue — maintenance never sheds); no
+                    # deadline is installed on worker threads, so this
+                    # blocks until user traffic drains a slot
+                    ticket = self._wlm.admit(self._wlm_request())
+                try:
+                    task.result = task.fn()
+                finally:
+                    if ticket is not None:
+                        self._wlm.release(ticket)
                 with self._cv:
                     task.status = JobStatus.DONE
                     self._cv.notify_all()

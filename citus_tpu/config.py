@@ -189,11 +189,64 @@ _register(ConfigVar(
 _register(ConfigVar(
     "statement_timeout_ms", 0,
     "Cooperative per-statement deadline, checked at fault points, "
-    "stream/COPY batch boundaries and retry iterations; raises "
-    "StatementTimeout (PostgreSQL statement_timeout analogue; the "
-    "reference additionally enforces citus.node_connection_timeout "
-    "per worker connection). 0 disables.",
+    "stream/COPY batch boundaries, retry iterations and workload-"
+    "manager queue waits; ONE budget spans admission queueing plus "
+    "execution. Raises StatementTimeout (PostgreSQL statement_timeout "
+    "analogue; the reference additionally enforces "
+    "citus.node_connection_timeout per worker connection). 0 disables.",
     int, min_value=0, max_value=86_400_000))
+
+# --- workload management (wlm/ — the shared-pool governor analogue) -------
+def _validate_tenant_weights(value: str) -> None:
+    from .wlm.manager import parse_tenant_weights
+
+    parse_tenant_weights(value)  # raises ConfigError on malformed spec
+
+
+_register(ConfigVar(
+    "wlm_enabled", True,
+    "Route every non-exempt statement through the workload manager's "
+    "admission gate (slots + HBM budget + per-tenant fair queue, "
+    "wlm/manager.py).  Off restores the ungoverned race into the "
+    "executor (ref: the citus.max_shared_pool_size governor as a "
+    "whole, shared_library_init.c).",
+    bool))
+_register(ConfigVar(
+    "max_concurrent_statements", 8,
+    "Admission slots: statements executing concurrently across every "
+    "session sharing this data_dir; the rest queue per tenant and "
+    "priority class (ref: citus.max_shared_pool_size / "
+    "citus.max_adaptive_executor_pool_size).",
+    int, min_value=1, max_value=1024))
+_register(ConfigVar(
+    "wlm_queue_depth", 64,
+    "Bounded admission queue per priority class; arrivals beyond it "
+    "shed with a clean AdmissionRejected instead of queueing without "
+    "bound (overload backpressure; 0 sheds whenever the gate is "
+    "saturated).",
+    int, min_value=0, max_value=1_000_000))
+_register(ConfigVar(
+    "wlm_default_priority", "interactive",
+    "Priority class this session's statements enqueue at.  Classes "
+    "dispatch strictly interactive > batch > background; background "
+    "rebalance/maintenance jobs always enqueue at background.",
+    str, choices=("interactive", "batch", "background")))
+_register(ConfigVar(
+    "wlm_tenant", "",
+    "Explicit tenant identity for fair queueing.  Empty derives the "
+    "tenant from the statement's distcol = const pin (the "
+    "citus_stat_tenants attribution, stats/tenants.py), falling back "
+    "to 'default'.",
+    str))
+_register(ConfigVar(
+    "wlm_tenant_weights", "",
+    "Weighted round-robin shares per tenant within a priority class, "
+    "as 'tenantA:3,tenantB:1' (unlisted tenants weigh 1).  A tenant "
+    "with weight w dispatches w statements per round while others "
+    "wait their turn — proportional share, no starvation within a "
+    "class (ref: citus_stat_tenants attribution + the rebalancer's "
+    "by-disk-size strategy weights).",
+    str, validate=_validate_tenant_weights))
 
 # --- columnar storage (ref: columnar GUCs + columnar.options catalog) -----
 _register(ConfigVar(

@@ -71,6 +71,15 @@ class StatementTimeout(QueryCanceled):
     statement carries one cooperative deadline)."""
 
 
+class AdmissionRejected(CitusTpuError):
+    """The workload manager shed this statement instead of queueing it
+    without bound: the admission queue for its priority class was full
+    (wlm_queue_depth).  The analogue of the reference failing a query
+    when citus.max_shared_pool_size leaves no connection slot and the
+    wait would exceed its bounds — a clean, immediately-retryable-by-
+    the-client error, never a half-executed statement."""
+
+
 class ExecutionError(CitusTpuError):
     """Runtime failure during distributed execution."""
 

@@ -10,6 +10,7 @@ ResultSet.  Overflowed static buffers trigger recompile-with-doubled-caps
 from __future__ import annotations
 
 import math
+import threading
 from dataclasses import dataclass
 
 import numpy as np
@@ -109,6 +110,10 @@ class Executor:
         # most ONCE per plan shape, or generic (prepared) plans would
         # recompile on every parameter value's slightly different actuals
         self._tightened_fps: set = set()
+        # concurrent execute() threads share this executor: the memo
+        # dict is iterated while being written (_memoize_caps), which
+        # CPython turns into "dict changed size during iteration"
+        self._caps_lock = threading.Lock()
 
     # ------------------------------------------------------------------
     def execute_plan(self, plan: QueryPlan, raw: bool = False) -> ResultSet:
@@ -150,7 +155,8 @@ class Executor:
                        str(compute_dtype), feeds_signature(plan, feeds),
                        topk_sig, orp_sig,
                        self.settings.get("group_by_kernel"))
-        memo = self._caps_memo.get(fingerprint)
+        with self._caps_lock:
+            memo = self._caps_memo.get(fingerprint)
         caps = (self._caps_from_order(plan, memo) if memo is not None
                 else self._initial_capacities(plan, feeds))
         packed, out_meta, caps, retries = self.run_with_retry(
@@ -241,12 +247,16 @@ class Executor:
             cap_overflow = int(ov[:, 0].sum())
             dense_oob = int(ov[:, 1].sum())
             if cap_overflow == 0 and dense_oob == 0:
+                first_tighten = False
                 if allow_tighten and not tightened and \
-                        fingerprint not in self._tightened_fps and \
                         self.settings.get("enable_capacity_feedback"):
-                    if len(self._tightened_fps) > 512:
-                        self._tightened_fps.clear()
-                    self._tightened_fps.add(fingerprint)
+                    with self._caps_lock:
+                        if fingerprint not in self._tightened_fps:
+                            if len(self._tightened_fps) > 512:
+                                self._tightened_fps.clear()
+                            self._tightened_fps.add(fingerprint)
+                            first_tighten = True
+                if first_tighten:
                     tight = self._tighten_caps(
                         plan, caps, stage_keys,
                         ov[:, 2:].max(axis=0) if len(stage_keys) else [])
@@ -371,15 +381,19 @@ class Executor:
 
         from ..utils.io import atomic_write_json
 
-        if len(self._caps_memo) > 512:
-            self._caps_memo.clear()
-        self._caps_memo[fingerprint] = self._caps_to_order(plan, caps)
+        # snapshot under the lock (concurrent statements memoize while
+        # this thread serializes the items), write the file outside it
+        with self._caps_lock:
+            if len(self._caps_memo) > 512:
+                self._caps_memo.clear()
+            self._caps_memo[fingerprint] = self._caps_to_order(plan, caps)
+            payload = [[self._memo_to_json(k), self._memo_to_json(v)]
+                       for k, v in self._caps_memo.items()]
         try:
             atomic_write_json(
                 self._memo_path(),
                 {"version": self.CAPS_MEMO_VERSION,
-                 "memo": [[self._memo_to_json(k), self._memo_to_json(v)]
-                          for k, v in self._caps_memo.items()]})
+                 "memo": payload})
             # complete the pkl→json migration: the pickle predecessor
             # must not linger in a shared data_dir
             with contextlib.suppress(OSError):

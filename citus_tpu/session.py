@@ -47,7 +47,7 @@ _UDFS = ("create_distributed_table", "create_reference_table",
          "citus_move_shard_placement", "citus_get_node_clock",
          "citus_stat_counters", "citus_stat_counters_reset",
          "citus_stat_statements", "citus_stat_statements_reset",
-         "citus_stat_tenants", "citus_stat_activity",
+         "citus_stat_tenants", "citus_stat_activity", "citus_stat_wlm",
          "get_rebalance_progress",
          "citus_split_shard_by_split_points", "isolate_tenant_to_node",
          "citus_cleanup_orphaned_resources",
@@ -148,6 +148,15 @@ class Session:
         self.stats = SessionStats()
         self.executor = Executor(self.catalog, self.store, self.settings,
                                  self.mesh, counters=self.stats.counters)
+        # workload manager: sessions sharing a data_dir share ONE
+        # admission gate (they share the device, the compile cache and
+        # the HBM feed budget — wlm/manager.py)
+        from .wlm import workload_manager_for
+
+        self.wlm = workload_manager_for(self.data_dir)
+        # per-thread record of the last admission (EXPLAIN ANALYZE's
+        # Workload: line reads it after the admitted statement planned)
+        self._wlm_tls = threading.local()
         # transaction coordinator + shared lock table; interrupted 2PCs
         # from a previous process roll forward/back NOW, before any read
         # (the maintenance-daemon recovery pass at backend start;
@@ -169,7 +178,8 @@ class Session:
         from .background import BackgroundJobRunner, MaintenanceDaemon
 
         self.jobs = BackgroundJobRunner(
-            self.settings.get("max_background_task_executors"))
+            self.settings.get("max_background_task_executors"),
+            wlm=self.wlm, wlm_request=self._wlm_background_request)
         self.maintenance = MaintenanceDaemon(self)
         self.maintenance.start()
 
@@ -199,7 +209,7 @@ class Session:
                                        self.executor.plan_cache.misses,
                                        self.executor.feed_cache.hits,
                                        self.executor.feed_cache.misses)
-                result = self._execute_resilient(stmt, activity)
+                result = self._execute_admitted(stmt, activity)
                 self._count_statement(stmt, result)
                 tenant_hits.extend(extract_tenants(stmt, self.catalog))
             elapsed_ms = (_time.perf_counter() - t0) * 1000.0
@@ -234,6 +244,117 @@ class Session:
         elif isinstance(stmt, (ast.CreateTable, ast.DropTable)):
             c.increment(sc.DDL_COMMANDS)
 
+    # -- workload management -----------------------------------------------
+    def _wlm_background_request(self):
+        """Admission request for background job tasks (rebalance moves
+        etc., background/jobs.py): background class — user statements
+        always dispatch first — with an effectively unbounded queue (a
+        maintenance task waits for capacity rather than shedding)."""
+        from .wlm import AdmissionRequest
+
+        return AdmissionRequest(
+            tenant="background", priority="background",
+            max_slots=self.settings.get("max_concurrent_statements"),
+            max_feed_bytes=self.settings.get("max_feed_bytes_per_device"),
+            queue_depth=1_000_000)
+
+    def _execute_admitted(self, stmt: ast.Statement, activity=None):
+        """Admission wraps the resilience envelope: classify the
+        statement, hold a slot + HBM budget through every retry of its
+        execution, release at statement end.  Exempt statements
+        (utility, transaction control, admin UDFs, fast-path point
+        reads) skip the gate — see wlm/admission.py.  Queue waits honor
+        statement_timeout_ms and Session.cancel() exactly like
+        execution does."""
+        from .errors import (
+            AdmissionRejected,
+            QueryCanceled,
+            StatementTimeout,
+        )
+        from .stats import counters as sc
+        from .utils.cancellation import deadline_scope
+        from .wlm import (
+            AdmissionRequest,
+            parse_tenant_weights,
+            planned_feed_bytes,
+            statement_exempt,
+            statement_tenant,
+        )
+
+        self._wlm_tls.last = None
+        # EXECUTE name(...) classifies by its prepared target statement
+        # (the admission decision should see the real query shape)
+        target = stmt
+        if isinstance(stmt, ast.ExecutePrepared):
+            target = self._prepared.get(stmt.name, stmt)
+        # statements inside an OPEN transaction bypass the gate: the
+        # transaction already owns its resources (the reference's pool
+        # slot is acquired once and held for the txn), and queueing
+        # mid-transaction while holding 2PL locks would create
+        # slot↔lock deadlock cycles the lock-manager's detector cannot
+        # see (it only walks lock waits — a slot edge is invisible)
+        if self.txn_manager.current is not None or \
+                not self.settings.get("wlm_enabled") or \
+                statement_exempt(target, self.catalog, self.settings,
+                                 _UDFS):
+            return self._execute_resilient(stmt, activity)
+        tenant = statement_tenant(target, self.catalog, self.settings)
+        weights = parse_tenant_weights(
+            self.settings.get("wlm_tenant_weights"))
+        req = AdmissionRequest(
+            tenant=tenant,
+            priority=self.settings.get("wlm_default_priority"),
+            feed_bytes=planned_feed_bytes(target, self.catalog,
+                                          self.store, self.n_devices),
+            weight=weights.get(tenant, 1),
+            max_slots=self.settings.get("max_concurrent_statements"),
+            max_feed_bytes=self.settings.get("max_feed_bytes_per_device"),
+            queue_depth=self.settings.get("wlm_queue_depth"))
+        timeout_ms = self.settings.get("statement_timeout_ms")
+        if activity is not None:
+            activity.wait_state = "queued"
+        try:
+            # the queue wait carries the same deadline/cancel machinery
+            # as execution (check_cancel fires every wait slice)
+            with deadline_scope(timeout_ms or None, self._cancel_evt):
+                ticket = self.wlm.admit(req)
+        except Exception as e:
+            if activity is not None:
+                activity.wait_state = "running"
+            if isinstance(e, AdmissionRejected):
+                self.stats.counters.increment(sc.WLM_SHED_TOTAL)
+            elif isinstance(e, StatementTimeout):
+                self.stats.counters.increment(sc.TIMEOUTS_TOTAL)
+            elif isinstance(e, QueryCanceled):
+                self.stats.counters.increment(sc.QUERIES_CANCELED)
+            raise
+        if activity is not None:
+            activity.wait_state = "admitted"
+            activity.queued_ms = ticket.queued_ms
+        self.stats.counters.increment(sc.WLM_ADMITTED_TOTAL)
+        if ticket.was_queued:
+            self.stats.counters.increment(sc.WLM_QUEUED_TOTAL)
+            self.stats.counters.increment(
+                sc.WLM_QUEUE_WAIT_MS, int(round(ticket.queued_ms)))
+        self._wlm_tls.last = {
+            "tenant": ticket.tenant, "priority": ticket.priority,
+            "queued_ms": ticket.queued_ms,
+            "feed_bytes": ticket.feed_bytes,
+            "slots_in_use": ticket.slots_in_use,
+            "slots_total": ticket.slots_total}
+        # ONE deadline spans queue wait + execution: the time spent
+        # queued comes out of the execution budget (a statement must
+        # not run for ~2× its configured timeout)
+        remaining_ms = (max(1.0, timeout_ms - ticket.queued_ms)
+                        if timeout_ms else None)
+        try:
+            if activity is not None:
+                activity.wait_state = "running"
+            return self._execute_resilient(stmt, activity,
+                                           timeout_ms=remaining_ms)
+        finally:
+            self.wlm.release(ticket)
+
     # -- resilient statement execution -------------------------------------
     # fault points that fire AFTER a write's visibility flip: the effect
     # is already committed, so re-executing the statement would apply it
@@ -248,7 +369,8 @@ class Session:
         iteration — and raise QueryCanceled."""
         self._cancel_evt.set()
 
-    def _execute_resilient(self, stmt: ast.Statement, activity=None):
+    def _execute_resilient(self, stmt: ast.Statement, activity=None,
+                           timeout_ms=None):
         """One statement under the resilience envelope: a cooperative
         deadline (`statement_timeout_ms` + Session.cancel) around a
         bounded retry loop (`max_statement_retries`, exponential backoff
@@ -256,7 +378,11 @@ class Session:
         suspect so the retry's routing fails over to surviving replicas,
         and runs 2PC recovery first so no retry observes half-applied
         state — the adaptive executor's task-retry/failover loop
-        (adaptive_executor.c:95-116) hoisted to the statement level."""
+        (adaptive_executor.c:95-116) hoisted to the statement level.
+
+        `timeout_ms=None` reads `statement_timeout_ms`; the admission
+        wrapper passes the budget REMAINING after its queue wait so one
+        deadline spans the whole statement."""
         import random as _random
         import time as _time
 
@@ -265,7 +391,8 @@ class Session:
         from .utils.cancellation import check_cancel, deadline_scope
 
         max_retries = self.settings.get("max_statement_retries")
-        timeout_ms = self.settings.get("statement_timeout_ms")
+        if timeout_ms is None:
+            timeout_ms = self.settings.get("statement_timeout_ms")
         attempt = 0
         with deadline_scope(timeout_ms or None,
                             self._cancel_evt) as deadline:
@@ -765,18 +892,52 @@ class Session:
                 return max(0, live[i] - a.cache_base[i])
 
             return ResultSet(
-                ["global_pid", "query", "state", "retries",
+                ["global_pid", "query", "state", "wait_state",
+                 "queued_ms", "retries",
                  "plan_cache_hits", "plan_cache_misses",
                  "feed_cache_hits", "feed_cache_misses"],
                 {"global_pid": [a.gpid for a in entries],
                  "query": [a.query for a in entries],
                  "state": [a.state for a in entries],
+                 "wait_state": [a.wait_state for a in entries],
+                 "queued_ms": [round(a.queued_ms, 3) for a in entries],
                  "retries": [a.retries for a in entries],
                  "plan_cache_hits": [delta(a, 0) for a in entries],
                  "plan_cache_misses": [delta(a, 1) for a in entries],
                  "feed_cache_hits": [delta(a, 2) for a in entries],
                  "feed_cache_misses": [delta(a, 3) for a in entries]},
                 len(entries))
+        elif e.name == "citus_stat_wlm":
+            # workload-manager snapshot: gate occupancy + one row per
+            # (priority class, tenant) the shared governor has seen
+            snap = self.wlm.snapshot()
+            rows = snap["tenants"] or [
+                {"priority": "*", "tenant": "*", "queued": 0,
+                 "running": 0, "admitted_total": 0, "shed_total": 0,
+                 "weight": 0}]
+            return ResultSet(
+                ["priority", "tenant", "queued", "running",
+                 "admitted_total", "shed_total", "weight",
+                 "slots_in_use", "slots_total", "feed_bytes_admitted",
+                 "requests_total", "timedout_total", "canceled_total",
+                 "queue_wait_ms_total"],
+                {"priority": [r["priority"] for r in rows],
+                 "tenant": [r["tenant"] for r in rows],
+                 "queued": [r["queued"] for r in rows],
+                 "running": [r["running"] for r in rows],
+                 "admitted_total": [r["admitted_total"] for r in rows],
+                 "shed_total": [r["shed_total"] for r in rows],
+                 "weight": [r["weight"] for r in rows],
+                 "slots_in_use": [snap["slots_in_use"]] * len(rows),
+                 "slots_total": [snap["slots_total"]] * len(rows),
+                 "feed_bytes_admitted":
+                     [snap["feed_bytes_admitted"]] * len(rows),
+                 "requests_total": [snap["requests_total"]] * len(rows),
+                 "timedout_total": [snap["timedout_total"]] * len(rows),
+                 "canceled_total": [snap["canceled_total"]] * len(rows),
+                 "queue_wait_ms_total":
+                     [snap["queue_wait_ms_total"]] * len(rows)},
+                len(rows))
         elif e.name == "get_rebalance_progress":
             mons = self.stats.progress.all()
             return ResultSet(
@@ -1266,6 +1427,29 @@ class Session:
                     f"{fc.misses - cache0[3]} (session totals: plan "
                     f"{pc.hits}/{pc.misses}, feed {fc.hits}/{fc.misses}"
                     " hits/misses)")
+                # this statement's trip through the admission gate (the
+                # EXPLAIN ANALYZE statement itself was the admitted
+                # unit), plus session totals like the Resilience line
+                info = getattr(self._wlm_tls, "last", None)
+                w_adm = snap.get(sc.WLM_ADMITTED_TOTAL, 0)
+                w_q = snap.get(sc.WLM_QUEUED_TOTAL, 0)
+                w_s = snap.get(sc.WLM_SHED_TOTAL, 0)
+                if info is None:
+                    lines.append(
+                        "Workload: exempt (fast-path/utility or wlm "
+                        "disabled) (session totals: wlm_admitted_total="
+                        f"{w_adm} wlm_queued_total={w_q} "
+                        f"wlm_shed_total={w_s})")
+                else:
+                    lines.append(
+                        f"Workload: class={info['priority']} "
+                        f"tenant={info['tenant']} "
+                        f"queued_ms={info['queued_ms']:.1f} "
+                        f"slots={info['slots_in_use']}/"
+                        f"{info['slots_total']} "
+                        f"feed_bytes={info['feed_bytes']} "
+                        f"(session totals: wlm_admitted_total={w_adm} "
+                        f"wlm_queued_total={w_q} wlm_shed_total={w_s})")
             return ResultSet(["QUERY PLAN"], {"QUERY PLAN": lines},
                              len(lines))
         finally:

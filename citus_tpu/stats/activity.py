@@ -37,6 +37,12 @@ class ActivityEntry:
     # citus_stat_activity subtracts it from the live totals to show
     # the in-flight statement's own cache activity
     cache_base: tuple | None = None
+    # workload-manager state of the in-flight statement:
+    # queued (waiting for an admission slot) | admitted (slot granted,
+    # not yet executing) | running (executing, or exempt from the gate)
+    wait_state: str = "running"
+    # time the in-flight statement spent in the admission queue
+    queued_ms: float = 0.0
 
 
 class ActivityRegistry:

@@ -44,6 +44,11 @@ FAILOVERS_TOTAL = "failovers_total"
 TIMEOUTS_TOTAL = "timeouts_total"
 QUERIES_CANCELED = "queries_canceled"
 FAULTS_INJECTED_TOTAL = "faults_injected_total"
+# workload manager (wlm/manager.py admission gate)
+WLM_ADMITTED_TOTAL = "wlm_admitted_total"
+WLM_QUEUED_TOTAL = "wlm_queued_total"
+WLM_SHED_TOTAL = "wlm_shed_total"
+WLM_QUEUE_WAIT_MS = "wlm_queue_wait_ms"
 
 ALL_COUNTERS = [
     QUERIES_SINGLE_SHARD, QUERIES_MULTI_SHARD, QUERIES_REPARTITION,
@@ -55,6 +60,8 @@ ALL_COUNTERS = [
     CHUNKS_SKIPPED, QUERIES_STREAMED, GROUPBY_BUCKETED_TOTAL,
     RETRIES_TOTAL, FAILOVERS_TOTAL, TIMEOUTS_TOTAL, QUERIES_CANCELED,
     FAULTS_INJECTED_TOTAL,
+    WLM_ADMITTED_TOTAL, WLM_QUEUED_TOTAL, WLM_SHED_TOTAL,
+    WLM_QUEUE_WAIT_MS,
 ]
 
 

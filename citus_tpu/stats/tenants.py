@@ -19,6 +19,10 @@ class TenantStat:
     table: str
     query_count: int = 0
     total_time_ms: float = 0.0
+    # recency stamp (a per-registry logical clock, bumped on every
+    # record): the eviction tie-breaker — "coldest" means fewest
+    # queries AND least-recently seen
+    last_seen: int = 0
 
 
 class TenantStats:
@@ -26,24 +30,36 @@ class TenantStats:
         self.limit = limit
         self._lock = threading.Lock()
         self._stats: dict[tuple[str, str], TenantStat] = {}
+        self._clock = 0
 
     def record(self, table: str, tenant, elapsed_ms: float) -> None:
         key = (table, str(tenant))
         with self._lock:
+            self._clock += 1
             st = self._stats.get(key)
             if st is None:
                 if len(self._stats) >= self.limit:
-                    victim = min(self._stats,
-                                 key=lambda k: self._stats[k].query_count)
+                    # deterministic coldest-first eviction: fewest
+                    # queries, then least-recently seen, then key order
+                    # (the old min() over query_count alone broke ties
+                    # by dict insertion order — which tenant survived
+                    # depended on arrival history, not coldness)
+                    victim = min(
+                        self._stats,
+                        key=lambda k: (self._stats[k].query_count,
+                                       self._stats[k].last_seen, k))
                     del self._stats[victim]
                 st = self._stats[key] = TenantStat(str(tenant), table)
             st.query_count += 1
+            st.last_seen = self._clock
             st.total_time_ms += elapsed_ms
 
     def entries(self) -> list[TenantStat]:
         with self._lock:
+            # hottest first; deterministic order under ties
             return sorted(self._stats.values(),
-                          key=lambda s: -s.query_count)
+                          key=lambda s: (-s.query_count, s.table,
+                                         s.tenant))
 
     def reset(self) -> None:
         with self._lock:

@@ -75,6 +75,7 @@ FAULT_POINTS: dict[str, str] = {
     "txn.apply": "transaction/manager.py — record durable, not applied",
     "cdc.append": "cdc/feed.py — change-journal append",
     "operations.shard_move": "operations/shard_transfer.py — mid-move",
+    "wlm.admit": "wlm/manager.py — admission gate entry",
 }
 
 _lock = threading.Lock()

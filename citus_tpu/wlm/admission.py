@@ -1,0 +1,205 @@
+"""Statement classification for the workload manager.
+
+Three questions, all answered from the parse tree + catalog (no plan
+exists yet — admission sits between parse and execution, exactly where
+the reference's fast-path router decides from the parse tree,
+fast_path_router_planner.c:530):
+
+* **exempt?** — utility/transaction-control statements, admin-UDF
+  calls, and single-shard fast-path point reads skip the gate: they
+  are host-only and cheap, and blocking BEGIN/COMMIT behind a slot
+  could wedge a transaction whose statements already hold locks.
+  (The session additionally exempts every statement inside an OPEN
+  transaction — that is session state, not statement shape; see
+  Session._execute_admitted.)
+* **which tenant / class?** — the session's ``wlm_tenant`` override,
+  else the tenant key the statement pins via ``distcol = const``
+  (the citus_stat_tenants attribution, stats/tenants.py), else
+  ``"default"``.  The class is the session's ``wlm_default_priority``;
+  background jobs enqueue at ``background`` through their own runner.
+* **planned feed bytes?** — the per-device HBM the statement's base
+  tables would feed: hash tables divide across devices, reference
+  tables replicate whole.  On-disk shard sizes stand in for array
+  bytes (an estimate, not an accounting of compression ratios — the
+  gate guards against gross oversubscription, the stream pipeline
+  bounds the residency of any single admitted statement).
+"""
+
+from __future__ import annotations
+
+from ..catalog import Catalog, DistributionMethod
+from ..sql import ast
+
+# statement kinds that never touch the device path: catalog/host-only
+# work the gate would only add latency to (and transaction control,
+# which must never wait behind the statements of its own transaction)
+_EXEMPT_KINDS = (
+    ast.TransactionStmt, ast.SetVariable, ast.ShowVariable,
+    ast.Prepare, ast.Deallocate, ast.CreateView, ast.DropView,
+    ast.CreateSequence, ast.DropSequence, ast.CreateTable,
+    ast.DropTable, ast.AlterTable,
+)
+
+
+def _is_udf_call(sel: ast.Select, udfs) -> bool:
+    return (not sel.from_items and len(sel.items) == 1
+            and isinstance(sel.items[0].expr, ast.FuncCall)
+            and sel.items[0].expr.name in udfs)
+
+
+def fastpath_exempt_shape(sel: ast.Select, catalog: Catalog,
+                          settings=None) -> bool:
+    """Parse-tree fast-path shape: one hash-distributed table, the
+    distribution column pinned to a literal, no aggregates/subqueries.
+    Mirrors (conservatively) executor/fastpath.fast_path_shape, which
+    re-checks on the bound plan — a statement exempted here that the
+    planner then routes to the device still executes correctly, it
+    just bypassed the gate (the same slack the reference accepts
+    between FastPathRouterQuery and the real router plan)."""
+    if settings is not None and \
+            not settings.get("enable_fast_path_router"):
+        return False
+    if sel.ctes or sel.group_by or sel.having is not None or \
+            sel.distinct or sel.semi_joins:
+        return False
+    if len(sel.from_items) != 1 or \
+            not isinstance(sel.from_items[0], ast.TableRef):
+        return False
+    ref = sel.from_items[0]
+    if not catalog.has_table(ref.name):
+        return False
+    meta = catalog.table(ref.name)
+    if meta.method != DistributionMethod.HASH:
+        return False
+    if sel.where is None:
+        return False
+    # any function call (aggregate or otherwise) or nested subquery
+    # disqualifies — the device path would run it
+    exprs = [it.expr for it in sel.items] + [sel.where]
+    for e in exprs:
+        for n in ast.walk_expr(e):
+            if isinstance(n, (ast.FuncCall, ast.ScalarSubquery,
+                              ast.InSubquery, ast.Exists)):
+                return False
+    from ..executor.host_eval import split_conjuncts
+
+    dcol = meta.distribution_column
+    quals = {ref.alias or ref.name, ref.name}
+    for c in split_conjuncts(sel.where):
+        if not (isinstance(c, ast.BinaryOp) and c.op == "="):
+            continue
+        col, lit = c.left, c.right
+        if not isinstance(col, ast.ColumnRef):
+            col, lit = c.right, c.left
+        if isinstance(col, ast.ColumnRef) and \
+                isinstance(lit, ast.Literal) and lit.value is not None \
+                and col.name == dcol and \
+                (col.table is None or col.table in quals):
+            return True
+    return False
+
+
+def statement_exempt(stmt: ast.Statement, catalog: Catalog,
+                     settings, udfs) -> bool:
+    """True when `stmt` skips admission entirely."""
+    if isinstance(stmt, _EXEMPT_KINDS):
+        return True
+    if isinstance(stmt, ast.Explain):
+        # plain EXPLAIN plans without executing; ANALYZE runs the query
+        return not stmt.analyze
+    if isinstance(stmt, ast.Select):
+        if _is_udf_call(stmt, udfs):
+            return True
+        return fastpath_exempt_shape(stmt, catalog, settings)
+    return False
+
+
+def _collect_tables(fi: ast.FromItem, out: set[str]) -> None:
+    if isinstance(fi, ast.TableRef):
+        out.add(fi.name)
+    elif isinstance(fi, ast.Join):
+        _collect_tables(fi.left, out)
+        _collect_tables(fi.right, out)
+    elif isinstance(fi, ast.SubqueryRef):
+        out.update(statement_tables(fi.query))
+
+
+def statement_tables(stmt: ast.Statement) -> set[str]:
+    """Base tables a statement's execution will feed (coarse: CTE and
+    expression-subquery bodies are included, views are not expanded)."""
+    tables: set[str] = set()
+    if isinstance(stmt, ast.Select):
+        for fi in stmt.from_items:
+            _collect_tables(fi, tables)
+        for cte in stmt.ctes:
+            tables.update(statement_tables(cte.query))
+    elif isinstance(stmt, ast.SetOp):
+        tables.update(statement_tables(stmt.left))
+        tables.update(statement_tables(stmt.right))
+    elif isinstance(stmt, (ast.Update, ast.Delete)):
+        tables.add(stmt.table)
+    elif isinstance(stmt, ast.Merge):
+        tables.add(stmt.target)
+        _collect_tables(stmt.source, tables)
+    elif isinstance(stmt, ast.InsertSelect):
+        tables.add(stmt.table)
+        tables.update(statement_tables(stmt.query))
+    elif isinstance(stmt, (ast.InsertValues, ast.CopyFrom)):
+        tables.add(stmt.table)
+    elif isinstance(stmt, ast.Explain):
+        tables.update(statement_tables(stmt.statement))
+    return tables
+
+
+def read_tables(stmt: ast.Statement) -> set[str]:
+    """Tables whose data the statement READS (what actually feeds HBM).
+    Write-only targets are excluded: INSERT VALUES / COPY route rows
+    host-side in bounded batches and never materialize the target as a
+    device feed, so charging them the table's size would serialize
+    concurrent small writes into a large table for nothing."""
+    if isinstance(stmt, (ast.InsertValues, ast.CopyFrom)):
+        return set()
+    if isinstance(stmt, ast.InsertSelect):
+        return statement_tables(stmt.query)
+    if isinstance(stmt, ast.Explain):
+        return read_tables(stmt.statement)
+    return statement_tables(stmt)
+
+
+def planned_feed_bytes(stmt: ast.Statement, catalog: Catalog, store,
+                       n_devices: int) -> int:
+    """Per-device feed-byte estimate for the HBM admission gate."""
+    total = 0
+    for t in read_tables(stmt):
+        if not catalog.has_table(t):
+            continue
+        try:
+            shards = catalog.table_shards(t)
+            tbytes = sum(store.shard_size_bytes(t, s.shard_id)
+                         for s in shards)
+            meta = catalog.table(t)
+        except Exception:
+            continue
+        if meta.method == DistributionMethod.HASH and n_devices > 0:
+            total += -(-tbytes // n_devices)
+        else:
+            total += tbytes  # reference/local tables replicate whole
+    return total
+
+
+def statement_tenant(stmt: ast.Statement, catalog: Catalog,
+                     settings) -> str:
+    """Tenant attribution for fair queueing: explicit session identity
+    first, else the statement's pinned tenant key, else 'default'."""
+    explicit = settings.get("wlm_tenant")
+    if explicit:
+        return str(explicit)
+    try:
+        from ..stats import extract_tenants
+
+        hits = extract_tenants(stmt, catalog)
+    except Exception:
+        hits = []
+    if hits:
+        return str(hits[0][1])
+    return "default"

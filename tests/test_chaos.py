@@ -5,12 +5,16 @@ mitmproxy kill/delay interposition (src/test/regress/mitmscripts/) and
 asserting queries either answer correctly or fail cleanly.  Here the
 fault engine (citus_tpu/utils/faultinjection.py) arms random named
 points around a generated workload (tests/fuzzer.py chaos mode) across
-two sessions sharing one data_dir, and the soak asserts the invariant:
+three sessions sharing one data_dir — every non-exempt statement rides
+the shared workload-manager admission gate (wlm/, max 2 slots) — and
+the soak asserts the invariant:
 
     every statement either agrees with the host-side oracle model or
     raises a clean CitusTpuError — and the store stays uncorrupted
     (post-soak recover_transactions() + full-table checksum agree
-    across live sessions, a fresh session, and the model).
+    across live sessions, a fresh session, and the model).  The gate
+    itself must lose nothing: its ledger resolves every admission
+    request as admitted XOR shed XOR timed-out/canceled.
 
 A failed WRITE has an inherently ambiguous outcome (the fault may have
 hit before or after the visibility flip — the lost-COMMIT-ack problem),
@@ -55,6 +59,8 @@ FAULT_POOL = [
     dict(name="cdc.append"),
     dict(name="store.read_shard", error=None, sleep=0.005),
     dict(name="store.read_shard", p=0.5, times=2),
+    dict(name="wlm.admit"),
+    dict(name="wlm.admit", p=0.5, times=2),
 ]
 
 
@@ -76,8 +82,8 @@ def _run_soak(tmp_path, n_ops: int, seed: int, fault_rate: float):
     mk = lambda: citus_tpu.connect(  # noqa: E731
         data_dir=data_dir, n_devices=2, retry_backoff_base_ms=1,
         retry_backoff_max_ms=5, max_statement_retries=2,
-        shard_replication_factor=2)
-    sessions = [mk(), mk()]
+        shard_replication_factor=2, max_concurrent_statements=2)
+    sessions = [mk(), mk(), mk()]
     s0 = sessions[0]
     s0.execute("CREATE TABLE kv (id INT, v INT)")
     s0.execute("SELECT create_distributed_table('kv', 'id', 4)")
@@ -164,9 +170,17 @@ def _run_soak(tmp_path, n_ops: int, seed: int, fault_rate: float):
     checksums = [_read_store(sess) for sess in sessions]
     fresh = citus_tpu.connect(data_dir=data_dir, n_devices=2)
     checksums.append(_read_store(fresh))
-    assert checksums[0] == checksums[1] == checksums[2], \
+    assert all(c == checksums[0] for c in checksums[1:]), \
         "sessions disagree on committed state (store corrupted)"
     assert checksums[0] == model, "model diverged from committed state"
+    # the admission gate lost nothing: every request resolved exactly
+    # one way, and no slot leaked across the whole fault-armed soak
+    wlm = sessions[0].wlm.snapshot()
+    assert wlm["requests_total"] == (
+        wlm["admitted_total"] + wlm["shed_total"]
+        + wlm["timedout_total"] + wlm["canceled_total"]), wlm
+    assert wlm["slots_in_use"] == 0 and wlm["feed_bytes_admitted"] == 0
+    assert wlm["admitted_total"] > 0
     for sess in sessions:
         sess.close()
     fresh.close()
@@ -182,8 +196,8 @@ class TestChaosSoak:
     @pytest.mark.slow
     def test_full_soak(self, tmp_path):
         """Acceptance soak: ≥200 statements, ≥25% fault-armed, mixed
-        DML/SELECT/COPY over 2 sessions, zero oracle mismatches, zero
-        corruption."""
+        DML/SELECT/COPY over 3 sessions through the admission gate,
+        zero oracle mismatches, zero corruption."""
         stats = _run_soak(tmp_path, n_ops=160, seed=20260803,
                           fault_rate=0.4)
         assert stats["stmts"] >= 200

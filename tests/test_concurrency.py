@@ -66,6 +66,45 @@ def test_four_threads_with_background_rebalance(sess):
     assert int(r.rows()[0][0]) == EXPECTED_SUM
 
 
+def test_cached_plan_hits_thread_safe_across_sessions(sess, tmp_path):
+    """Thread-safety audit regression (wlm round): hammer cached-plan
+    hits from two sessions sharing the data_dir AND two threads inside
+    each — the executor's capacity memo used to be iterated while
+    written (dict-changed-size crash), and the plan/feed caches must
+    serve torn-free entries under concurrent get/put."""
+    sess2 = citus_tpu.connect(data_dir=str(tmp_path / "d"), n_devices=4,
+                              compute_dtype="float64")
+    # warm both plan caches so the loop runs on the cached-hit path
+    for s in (sess, sess2):
+        s.execute("select sum(v), count(*) from cq")
+        s.execute("select g, count(*) from cq group by g")
+
+    errors: list = []
+
+    def hammer(s):
+        try:
+            for _ in range(8):
+                r = s.execute("select sum(v), count(*) from cq")
+                assert int(r.rows()[0][0]) == EXPECTED_SUM
+                r2 = s.execute("select g, count(*) from cq group by g")
+                assert sum(int(x[1]) for x in r2.rows()) == 1200
+        except Exception as e:  # pragma: no cover - surfaced below
+            errors.append(e)
+
+    threads = [threading.Thread(target=hammer, args=(s,))
+               for s in (sess, sess2) for _ in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    try:
+        assert not errors, errors[0]
+        for s in (sess, sess2):
+            assert s.executor.plan_cache.hits > 0
+    finally:
+        sess2.close()
+
+
 def test_parallel_rebalance_moves_not_fully_chained(sess):
     """Moves touching disjoint node pairs must not depend on each other
     (the reference parallelizes across nodes under per-node caps)."""

@@ -1,11 +1,13 @@
 """Randomized query fuzzing vs the sqlite oracle (VERDICT round-2 item 9;
 reference: src/test/regress/citus_tests/query_generator/).
 
-FUZZ_N env overrides the query count (default 60 ≈ 3.5 min — each unique
-query pays an XLA compile; FUZZ_N=500 is the long validation run);
-FUZZ_SEED pins the run.  A mismatch shrinks to the smallest failing
-query and reports its SQL — add that SQL to test_regressions.py when
-fixing.
+Two entry points: `test_fuzz_smoke` is the deterministic 12-query
+tier-1 slice; the full `test_fuzz_against_oracle` is `slow` (FUZZ_N
+env overrides its query count, default 60 — each unique query pays an
+XLA compile, ~930 s measured on the 1-core tier-1 sandbox;
+FUZZ_N=500 is the long validation run; FUZZ_SEED pins the run).  A
+mismatch shrinks to the smallest failing query and reports its SQL —
+add that SQL to test_regressions.py when fixing.
 """
 
 import os
@@ -58,10 +60,26 @@ def _run_both(sess, conn, q: Fuzz) -> str | None:
     return None
 
 
+@pytest.mark.slow
 def test_fuzz_against_oracle(fuzz_env):
+    """The full fuzz run.  Marked `slow` (wlm round): tools/t1_times.py
+    measured it at ~930 s on the 1-core tier-1 sandbox — alone larger
+    than the whole 870 s gate, so the timed run died inside it and
+    every alphabetically-later file lost its dots.  Tier-1 fuzz
+    coverage rides test_fuzz_smoke below; FUZZ_N=500 stays the long
+    validation run."""
+    _fuzz_run(fuzz_env, int(os.environ.get("FUZZ_N", "60")),
+              int(os.environ.get("FUZZ_SEED", "20260730")))
+
+
+def test_fuzz_smoke(fuzz_env):
+    """Deterministic tier-1 slice: same generator/oracle/shrinker,
+    bounded query count (the chaos-soak smoke-slice pattern)."""
+    _fuzz_run(fuzz_env, 12, 20260731, sanity=False)
+
+
+def _fuzz_run(fuzz_env, n: int, seed: int, sanity: bool = True):
     sess, conn = fuzz_env
-    n = int(os.environ.get("FUZZ_N", "60"))
-    seed = int(os.environ.get("FUZZ_SEED", "20260730"))
     log_path = os.environ.get("FUZZ_LOG")  # crash forensics: last line =
     rng = random.Random(seed)              # the query that was executing
     planning_rejects = 0
@@ -91,6 +109,8 @@ def test_fuzz_against_oracle(fuzz_env):
             f"original: {sql}\n"
             f"shrunk:   {small.sql()}\n"
             f"mismatch: {mismatch}")
+    if not sanity:
+        return
     # sanity: the generator must mostly produce supported queries
     sanity_rng = random.Random(seed + 1)
     for _ in range(50):

@@ -84,6 +84,40 @@ def test_stat_tenants_attribution(sess):
     assert rows[("events", "2")] == 1
 
 
+def test_stat_tenants_eviction_is_deterministic():
+    """Overflowing the bounded tenant table evicts the COLDEST tenant
+    (fewest queries, then least-recently seen, then key order) — not
+    whichever minimal-count entry happened to be inserted first."""
+    from citus_tpu.stats.tenants import TenantStats
+
+    ts = TenantStats(limit=3)
+    ts.record("t", "a", 1.0)   # a: 1 query, seen @1
+    ts.record("t", "b", 1.0)   # b: 1 query, seen @2
+    ts.record("t", "b", 1.0)   # b: 2 queries
+    ts.record("t", "c", 1.0)   # c: 1 query, seen @4
+    ts.record("t", "a", 1.0)   # a: 2 queries, seen @5 — c is now coldest
+    # table full (a, b, c); a new tenant evicts c (1 query) even though
+    # a was inserted first
+    ts.record("t", "d", 1.0)
+    tenants = {s.tenant for s in ts.entries()}
+    assert tenants == {"a", "b", "d"}
+    # fewest-queries outranks recency
+    ts2 = TenantStats(limit=2)
+    ts2.record("t", "x", 1.0)
+    ts2.record("t", "y", 1.0)
+    ts2.record("t", "x", 1.0)  # x:2 queries, y:1
+    ts2.record("t", "z", 1.0)  # y evicts on count despite being newer
+    assert {s.tenant for s in ts2.entries()} == {"x", "z"}
+    # equal counts: the least-recently-seen tenant evicts
+    ts3 = TenantStats(limit=2)
+    ts3.record("t", "p", 1.0)
+    ts3.record("t", "q", 1.0)
+    ts3.record("t", "p", 1.0)
+    ts3.record("t", "q", 1.0)  # p:2 (seen @3), q:2 (seen @4)
+    ts3.record("t", "r", 1.0)  # p is least-recent → evicted
+    assert {s.tenant for s in ts3.entries()} == {"q", "r"}
+
+
 def test_stat_counters_thread_slots():
     import threading
 

@@ -1,0 +1,49 @@
+"""tools/t1_times.py — tier-1 duration-report parsing."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                "tools"))
+
+from t1_times import budget_cutoff, by_file, parse_durations  # noqa: E402
+
+SAMPLE = """\
+============================= slowest durations ==============================
+12.50s call     tests/test_a.py::test_big
+0.50s setup    tests/test_a.py::test_big
+3.00s call     tests/test_b.py::TestC::test_mid
+0.10s teardown tests/test_b.py::TestC::test_mid
+1.00s call     tests/test_c.py::test_small
+(3 durations < 0.005s hidden)
+1 passed in 17.10s
+"""
+
+
+def test_parse_durations_sums_phases():
+    totals = parse_durations(SAMPLE)
+    assert totals["tests/test_a.py::test_big"] == 13.0
+    assert totals["tests/test_b.py::TestC::test_mid"] == 3.1
+    assert totals["tests/test_c.py::test_small"] == 1.0
+
+
+def test_by_file_groups():
+    files = by_file(parse_durations(SAMPLE))
+    assert files == {"tests/test_a.py": 13.0, "tests/test_b.py": 3.1,
+                     "tests/test_c.py": 1.0}
+
+
+def test_budget_cutoff_orders_alphabetically():
+    totals = parse_durations(SAMPLE)
+    assert budget_cutoff(totals, budget=14.0) == ["tests/test_b.py",
+                                                  "tests/test_c.py"]
+    assert budget_cutoff(totals, budget=100.0) == []
+
+
+def test_budget_cutoff_mirrors_conftest_front_loading():
+    """The tool must rank in the suite's ACTUAL run order: conftest
+    front-loads test_wlm.py/test_tools.py, so they consume budget
+    FIRST even though they sort last alphabetically."""
+    totals = {"tests/test_a.py::t": 5.0, "tests/test_wlm.py::t": 5.0}
+    # 6s budget: test_wlm (front-loaded) fits, test_a is cut off
+    assert budget_cutoff(totals, budget=6.0) == ["tests/test_a.py"]

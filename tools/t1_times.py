@@ -1,0 +1,126 @@
+"""Tier-1 budget tooling: rank the slowest tests from pytest
+``--durations`` output.
+
+The tier-1 gate (ROADMAP.md) runs ``pytest -q -m 'not slow'`` under a
+fixed wall-clock budget and counts passing dots — tests past the
+timeout never run, so every second a slow test burns near the front of
+the suite is a dot some later file loses.  This tool turns a profiling
+run into the marking decision:
+
+    # profile once (takes the full suite duration):
+    JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+        --durations=0 -p no:xdist > /tmp/t1_durations.log
+    # rank:
+    python tools/t1_times.py /tmp/t1_durations.log --top 25
+    python tools/t1_times.py /tmp/t1_durations.log --by-file
+
+Tests whose cost dwarfs their dot contribution are candidates for the
+``slow`` marker (they still run in the full suite); ``--budget 870``
+estimates where the tier-1 cutoff would land in file order.
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+from collections import defaultdict
+
+# pytest --durations lines: "12.34s call     tests/test_x.py::test_y"
+_DURATION_RE = re.compile(
+    r"^\s*(\d+(?:\.\d+)?)s\s+(call|setup|teardown)\s+(\S+)")
+
+
+def parse_durations(text: str) -> dict[str, float]:
+    """test nodeid → total seconds across its call/setup/teardown."""
+    totals: dict[str, float] = defaultdict(float)
+    for line in text.splitlines():
+        m = _DURATION_RE.match(line)
+        if m:
+            totals[m.group(3)] += float(m.group(1))
+    return dict(totals)
+
+
+def by_file(totals: dict[str, float]) -> dict[str, float]:
+    out: dict[str, float] = defaultdict(float)
+    for nodeid, secs in totals.items():
+        out[nodeid.split("::", 1)[0]] += secs
+    return dict(out)
+
+
+# must mirror tests/conftest.py::_TIER1_FIRST — the collection hook
+# runs these files before the alphabetical remainder
+TIER1_FIRST = ("test_tools.py", "test_wlm.py")
+
+
+def budget_cutoff(totals: dict[str, float], budget: float) -> list[str]:
+    """Files (in the suite's ACTUAL run order: conftest's front-loaded
+    files first, then alphabetical) whose cumulative time exceeds
+    `budget` — the tests a timed tier-1 run never reaches.  An
+    estimate: per-test durations undercount collection/import time, so
+    the real cutoff lands somewhat earlier."""
+    import os
+
+    files = by_file(totals)
+    spent = 0.0
+    unreached = []
+    run_order = sorted(files, key=lambda f: (
+        0 if os.path.basename(f) in TIER1_FIRST else 1, f))
+    for f in run_order:
+        spent += files[f]
+        if spent > budget:
+            unreached.append(f)
+    return unreached
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    top = 20
+    budget = None
+    show_files = False
+    path = None
+    it = iter(argv)
+    for a in it:
+        if a == "--top":
+            top = int(next(it))
+        elif a == "--budget":
+            budget = float(next(it))
+        elif a == "--by-file":
+            show_files = True
+        elif a in ("-h", "--help"):
+            print(__doc__)
+            return 0
+        else:
+            path = a
+    text = open(path).read() if path else sys.stdin.read()
+    totals = parse_durations(text)
+    if not totals:
+        print("no --durations lines found (run pytest with "
+              "--durations=0)", file=sys.stderr)
+        return 1
+    if show_files:
+        files = by_file(totals)
+        print(f"{'seconds':>9}  file")
+        for f, secs in sorted(files.items(), key=lambda kv: -kv[1]):
+            print(f"{secs:9.2f}  {f}")
+    else:
+        print(f"{'seconds':>9}  test")
+        ranked = sorted(totals.items(), key=lambda kv: -kv[1])
+        for nodeid, secs in ranked[:top]:
+            print(f"{secs:9.2f}  {nodeid}")
+        rest = sum(s for _, s in ranked[top:])
+        print(f"{rest:9.2f}  ({max(0, len(ranked) - top)} more tests)")
+        print(f"{sum(totals.values()):9.2f}  total")
+    if budget is not None:
+        unreached = budget_cutoff(totals, budget)
+        if unreached:
+            print(f"\nfiles a {budget:.0f}s tier-1 run never reaches "
+                  "(alphabetical order):")
+            for f in unreached:
+                print(f"  {f}")
+        else:
+            print(f"\nthe whole suite fits the {budget:.0f}s budget")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
