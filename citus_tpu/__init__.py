@@ -33,6 +33,16 @@ from .errors import (
 )
 from .types import ColumnDef, DataType, TableSchema, sql_type_to_datatype
 
+# CITUS_TPU_TSAN=1 arms the runtime lock-order sanitizer BEFORE any
+# session/manager lock is created (analysis/sanitizer.py; the runtime
+# half of graftlint).  No-op — and no sanitizer import — otherwise.
+import os as _os
+
+if _os.environ.get("CITUS_TPU_TSAN") == "1":
+    from .analysis.sanitizer import maybe_enable_from_env
+
+    maybe_enable_from_env()
+
 __version__ = "0.1.0"
 
 __all__ = [

@@ -1,0 +1,45 @@
+"""graftlint: concurrency + TPU hot-path static analysis.
+
+The engine is genuinely concurrent (shared per-data_dir managers, WLM
+admission, background jobs, multi-session chaos) and hot paths live or
+die on disciplined data movement — exactly the two failure classes
+humans audit worst.  This package machine-checks both on every PR.
+
+Four static rule families over the ``citus_tpu/`` + ``tools/`` tree:
+
+* **lock discipline** (`lockgraph.py`) — builds the static
+  lock-acquisition graph (every ``with <lock>:`` / ``.acquire()`` site,
+  interprocedurally through direct calls), flags cycles (potential
+  deadlocks) and writes to guarded attributes of lock-owning classes
+  outside their owning lock;
+* **TPU hot-path hygiene** (`hotpath.py`) — flags implicit device→host
+  syncs inside traced (jit / shard_map / pallas) functions, Python
+  branches on traced values, blocking transfers inside streaming
+  loops, and jit-in-loop recompile churn;
+* **registry sync** (`registries.py`) — fault-point names, counter
+  names, config vars and EXPLAIN tags used in source must each appear
+  in their registry and vice versa;
+* **error/resource discipline** (`discipline.py`) — bare ``except:``,
+  swallowed ``BaseException``, broad handlers that swallow fault-point
+  seams, raw lock ``.acquire()`` outside context managers, threads
+  started without join/daemon ownership.
+
+Findings are suppressed either inline (``# graftlint: ignore[rule]``)
+or via the repo-root ``lint_baseline.json`` where every entry carries a
+``why`` justification.  CLI: ``python -m citus_tpu.analysis [--json]``.
+
+The runtime half (`sanitizer.py`) is an opt-in lock-order sanitizer
+(``CITUS_TPU_TSAN=1``): wraps ``threading.Lock``/``RLock`` creation,
+records per-thread acquisition stacks, and asserts one globally
+consistent lock order — armed in the chaos soak and concurrency tests.
+"""
+
+from __future__ import annotations
+
+from .core import (  # noqa: F401
+    Finding,
+    collect_modules,
+    load_baseline,
+    run_lint,
+    unbaselined,
+)

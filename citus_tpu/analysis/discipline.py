@@ -1,0 +1,166 @@
+"""Error/resource discipline: exception swallowing and thread ownership.
+
+* ``bare-except`` — ``except:`` catches SystemExit/KeyboardInterrupt
+  and the sanitizer's violations; always name the class.
+* ``swallowed-base-exception`` — ``except BaseException`` whose body
+  never re-raises: cancellation (StatementTimeout / QueryCanceled ride
+  the exception channel) silently dies here.
+* ``swallowed-fault-seam`` — a broad handler (``Exception`` or wider)
+  that swallows (no ``raise`` in its body) around a ``try`` block that
+  contains a ``fault_point(...)`` seam: injected faults and the
+  cooperative cancellation check inside the seam would be eaten, which
+  breaks both the chaos-soak invariant (clean answer OR clean error)
+  and statement timeouts.
+* ``silent-exception`` — ``except Exception: pass`` (body is only
+  pass/continue): best-effort code must narrow to the classes it
+  actually expects (OSError, ValueError, ...) or justify itself in the
+  baseline; "ignore everything" has already hidden real bugs here.
+* ``unowned-thread`` — ``threading.Thread(...)`` without
+  ``daemon=True`` and without a reachable ``.join()`` in the same
+  function: a non-daemon thread nobody joins keeps the process alive
+  after the session closes.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, Module, qualname_of
+
+_BROAD = ("Exception", "BaseException")
+
+
+def _handler_names(h: ast.ExceptHandler) -> list[str]:
+    t = h.type
+    if t is None:
+        return []
+    elts = t.elts if isinstance(t, ast.Tuple) else [t]
+    out = []
+    for e in elts:
+        if isinstance(e, ast.Name):
+            out.append(e.id)
+        elif isinstance(e, ast.Attribute):
+            out.append(e.attr)
+    return out
+
+
+def _body_reraises(h: ast.ExceptHandler) -> bool:
+    return any(isinstance(n, ast.Raise) for n in ast.walk(h))
+
+
+def _body_is_silent(h: ast.ExceptHandler) -> bool:
+    return all(isinstance(n, (ast.Pass, ast.Continue)) for n in h.body)
+
+
+def _contains_fault_point(nodes: list[ast.stmt]) -> bool:
+    for stmt in nodes:
+        for n in ast.walk(stmt):
+            if isinstance(n, ast.Call):
+                fn = n.func
+                name = (fn.id if isinstance(fn, ast.Name)
+                        else fn.attr if isinstance(fn, ast.Attribute)
+                        else None)
+                if name == "fault_point":
+                    return True
+    return False
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, mod: Module, findings: list[Finding]):
+        self.mod = mod
+        self.findings = findings
+        self.stack: list[ast.AST] = []
+
+    def _flag(self, rule: str, node: ast.AST, msg: str) -> None:
+        self.findings.append(Finding(rule, self.mod.relpath, node.lineno,
+                                     msg, qualname_of(self.stack)))
+
+    def _visit_scope(self, node) -> None:
+        self.stack.append(node)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    visit_FunctionDef = _visit_scope
+    visit_AsyncFunctionDef = _visit_scope
+    visit_ClassDef = _visit_scope
+
+    def visit_Try(self, node: ast.Try) -> None:
+        seam = _contains_fault_point(node.body)
+        for h in node.handlers:
+            names = _handler_names(h)
+            if h.type is None:
+                self._flag("bare-except", h,
+                           "bare `except:` catches SystemExit/"
+                           "KeyboardInterrupt — name the exception "
+                           "class")
+            elif "BaseException" in names and not _body_reraises(h):
+                self._flag("swallowed-base-exception", h,
+                           "`except BaseException` without re-raise "
+                           "swallows cancellation and injected faults")
+            elif seam and not _body_reraises(h) and \
+                    any(n in _BROAD for n in names):
+                self._flag("swallowed-fault-seam", h,
+                           "broad handler swallows a try block that "
+                           "contains a fault_point() seam — injected "
+                           "faults and timeout checks die here")
+            elif any(n in _BROAD for n in names) and _body_is_silent(h):
+                self._flag("silent-exception", h,
+                           "`except Exception: pass` — narrow to the "
+                           "classes this site actually expects")
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = node.func
+        is_thread_ctor = (
+            isinstance(fn, ast.Attribute) and fn.attr == "Thread"
+            and isinstance(fn.value, ast.Name)
+            and fn.value.id == "threading") or (
+            isinstance(fn, ast.Name) and fn.id == "Thread")
+        if is_thread_ctor:
+            has_daemon = any(
+                kw.arg == "daemon" and
+                isinstance(kw.value, ast.Constant) and
+                kw.value.value is True
+                for kw in node.keywords)
+            if not has_daemon and not self._joined_nearby():
+                self._flag("unowned-thread", node,
+                           "thread started without daemon=True and "
+                           "with no .join() in this function — nobody "
+                           "owns its shutdown")
+        self.generic_visit(node)
+
+    def _joined_nearby(self) -> bool:
+        """The enclosing function (or class, for threads stored on self
+        and joined by a sibling stop()/shutdown() method) calls
+        .join() in a thread-shaped way: the receiver is a plain
+        variable or a self-attribute, and the only allowed argument is
+        a timeout (positional numeric or keyword) — which excludes
+        ``os.path.join(...)``, ``",".join(xs)`` and ``sep.join(xs)``,
+        any of which would otherwise disable this rule for the whole
+        scope."""
+        for scope in reversed(self.stack):
+            for n in ast.walk(scope):
+                if not (isinstance(n, ast.Call)
+                        and isinstance(n.func, ast.Attribute)
+                        and n.func.attr == "join"):
+                    continue
+                recv = n.func.value
+                recv_ok = isinstance(recv, ast.Name) or (
+                    isinstance(recv, ast.Attribute)
+                    and isinstance(recv.value, ast.Name)
+                    and recv.value.id == "self")
+                args_ok = (not n.args or (
+                    len(n.args) == 1
+                    and isinstance(n.args[0], ast.Constant)
+                    and isinstance(n.args[0].value, (int, float))))
+                kw_ok = all(kw.arg == "timeout" for kw in n.keywords)
+                if recv_ok and args_ok and kw_ok:
+                    return True
+        return False
+
+
+def check(modules: list[Module]) -> list[Finding]:
+    findings: list[Finding] = []
+    for mod in modules:
+        _Visitor(mod, findings).visit(mod.tree)
+    return findings

@@ -1,0 +1,211 @@
+"""TPU hot-path hygiene: implicit device→host syncs and recompile churn.
+
+Scope: the modules that execute on or feed the device —
+``citus_tpu/executor/`` and ``citus_tpu/ops/``.  Four rules:
+
+* ``host-sync-in-traced`` — inside a *traced* function (decorated with
+  ``jax.jit`` / ``functools.partial(jax.jit, ...)``, passed to
+  ``shard_map``/``jax.jit``/``pl.pallas_call``, or nested in one),
+  calling host numpy (``np.*``) or ``float()/int()/bool()`` on a
+  non-literal, or ``.item()``: each forces a trace-time concretization
+  or a per-call device→host round trip.
+* ``traced-python-branch`` — ``if``/``while``/``assert`` on an
+  expression containing ``jnp.`` inside a traced function: Python
+  control flow on a traced boolean either crashes at trace time or
+  silently bakes one branch into the compiled program.
+* ``device-sync-in-loop`` — ``jax.device_get`` /
+  ``.block_until_ready()`` inside a ``for``/``while``
+  in the streaming/feed modules: each iteration pays a full round trip
+  on remote-attached TPUs, exactly the overlap the double-buffered
+  pipeline exists to hide.  Designed sync points carry an inline
+  ``# graftlint: ignore[device-sync-in-loop]`` with the reason.
+* ``jit-in-loop`` — ``jax.jit(...)`` called inside a loop: every
+  iteration builds a fresh callable whose compile cache is thrown
+  away; hoist the jit (or cache the jitted fn) outside the loop.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, Module, qualname_of
+
+HOT_PREFIXES = ("citus_tpu/executor/", "citus_tpu/ops/")
+STREAM_MODULES = ("citus_tpu/executor/stream.py",
+                  "citus_tpu/executor/feed.py",
+                  "citus_tpu/executor/batch.py")
+
+_TRACE_ENTRYPOINTS = ("shard_map", "pallas_call", "jit", "pjit")
+
+
+def _is_jit_decorator(dec: ast.expr) -> bool:
+    """@jax.jit / @jit / @functools.partial(jax.jit, ...)."""
+    if isinstance(dec, ast.Attribute) and dec.attr == "jit":
+        return True
+    if isinstance(dec, ast.Name) and dec.id == "jit":
+        return True
+    if isinstance(dec, ast.Call):
+        fn = dec.func
+        is_partial = (isinstance(fn, ast.Attribute) and
+                      fn.attr == "partial") or \
+                     (isinstance(fn, ast.Name) and fn.id == "partial")
+        if is_partial and dec.args:
+            return _is_jit_decorator(dec.args[0])
+        return _is_jit_decorator(fn)
+    return False
+
+
+def _call_name(fn: ast.expr) -> str | None:
+    if isinstance(fn, ast.Name):
+        return fn.id
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    return None
+
+
+def _contains_jnp(expr: ast.expr) -> bool:
+    for sub in ast.walk(expr):
+        if isinstance(sub, ast.Attribute) and \
+                isinstance(sub.value, ast.Name) and sub.value.id == "jnp":
+            return True
+    return False
+
+
+def _traced_function_names(tree: ast.AST) -> set[str]:
+    """Names of functions passed (as bare names) to trace entrypoints
+    anywhere in the module — `shard_map(body, ...)` marks `body`."""
+    traced: set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _call_name(node.func)
+        if name in _TRACE_ENTRYPOINTS:
+            for arg in node.args[:1]:
+                if isinstance(arg, ast.Name):
+                    traced.add(arg.id)
+            for kw in node.keywords:
+                if kw.arg in ("body", "f", "fun", "kernel") and \
+                        isinstance(kw.value, ast.Name):
+                    traced.add(kw.value.id)
+    return traced
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, mod: Module, traced_names: set[str],
+                 findings: list[Finding]):
+        self.mod = mod
+        self.traced_names = traced_names
+        self.findings = findings
+        self.stack: list[ast.AST] = []
+        self.traced_depth = 0
+        self.loop_depth = 0
+
+    def _ctx(self) -> str:
+        return qualname_of(self.stack)
+
+    def _flag(self, rule: str, node: ast.AST, msg: str) -> None:
+        self.findings.append(Finding(rule, self.mod.relpath,
+                                     node.lineno, msg, self._ctx()))
+
+    # -- traced-context tracking -------------------------------------------
+    def _visit_func(self, node) -> None:
+        traced = (any(_is_jit_decorator(d) for d in node.decorator_list)
+                  or node.name in self.traced_names
+                  or self.traced_depth > 0)
+        self.stack.append(node)
+        self.traced_depth += 1 if traced else 0
+        outer_loop = self.loop_depth
+        self.loop_depth = 0  # loops don't span function boundaries
+        self.generic_visit(node)
+        self.loop_depth = outer_loop
+        self.traced_depth -= 1 if traced else 0
+        self.stack.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self.stack.append(node)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    def _visit_loop(self, node) -> None:
+        self.loop_depth += 1
+        self.generic_visit(node)
+        self.loop_depth -= 1
+
+    visit_For = _visit_loop
+
+    def visit_While(self, node: ast.While) -> None:
+        if self.traced_depth and _contains_jnp(node.test):
+            self._flag("traced-python-branch", node,
+                       "Python `while` on a traced (jnp) expression — "
+                       "use lax.while_loop / lax.fori_loop")
+        self._visit_loop(node)
+
+    # -- rules -------------------------------------------------------------
+    def visit_If(self, node: ast.If) -> None:
+        if self.traced_depth and _contains_jnp(node.test):
+            self._flag("traced-python-branch", node,
+                       "Python `if` on a traced (jnp) expression — use "
+                       "jnp.where / lax.cond, or hoist to a static arg")
+        self.generic_visit(node)
+
+    def visit_Assert(self, node: ast.Assert) -> None:
+        if self.traced_depth and _contains_jnp(node.test):
+            self._flag("traced-python-branch", node,
+                       "assert on a traced (jnp) expression inside a "
+                       "traced function")
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = node.func
+        name = _call_name(fn)
+        in_traced = self.traced_depth > 0
+        if in_traced:
+            if isinstance(fn, ast.Attribute) and \
+                    isinstance(fn.value, ast.Name) and \
+                    fn.value.id in ("np", "numpy") and \
+                    fn.attr != "dtype":
+                self._flag("host-sync-in-traced", node,
+                           f"host numpy call np.{fn.attr}(...) inside a "
+                           "traced function concretizes the tracer "
+                           "(TracerArrayConversionError or silent "
+                           "device→host sync) — use jnp")
+            elif name in ("float", "int", "bool") and node.args and \
+                    not isinstance(node.args[0], ast.Constant):
+                self._flag("host-sync-in-traced", node,
+                           f"{name}() on a non-literal inside a traced "
+                           "function forces trace-time concretization")
+            elif isinstance(fn, ast.Attribute) and fn.attr == "item":
+                self._flag("host-sync-in-traced", node,
+                           ".item() inside a traced function is a "
+                           "device→host sync per call")
+        if self.loop_depth and name == "jit":
+            self._flag("jit-in-loop", node,
+                       "jax.jit(...) inside a loop recompiles (or "
+                       "re-wraps) every iteration — hoist the jitted "
+                       "callable out of the loop")
+        if self.loop_depth and self.mod.relpath in STREAM_MODULES and \
+                not in_traced:
+            if name == "device_get":
+                self._flag("device-sync-in-loop", node,
+                           "jax.device_get inside a streaming loop "
+                           "blocks the pipeline for a full device→host "
+                           "round trip per iteration")
+            elif isinstance(fn, ast.Attribute) and \
+                    fn.attr == "block_until_ready":
+                self._flag("device-sync-in-loop", node,
+                           ".block_until_ready() inside a streaming "
+                           "loop serializes transfer and compute")
+        self.generic_visit(node)
+
+
+def check(modules: list[Module]) -> list[Finding]:
+    findings: list[Finding] = []
+    for mod in modules:
+        if not mod.relpath.startswith(HOT_PREFIXES):
+            continue
+        traced = _traced_function_names(mod.tree)
+        _Visitor(mod, traced, findings).visit(mod.tree)
+    return findings

@@ -643,11 +643,16 @@ class Catalog:
         from ..utils.io import atomic_write_json
 
         atomic_write_json(path, self.to_json())
+        # _disk_stat is read/written under _lock by maybe_reload (the
+        # staleness probe); writing it bare here let a concurrent
+        # reload adopt a stat for bytes it hadn't merged yet
         try:
             st = os.stat(path)
-            self._disk_stat = (st.st_mtime_ns, st.st_size, st.st_ino)
+            stat = (st.st_mtime_ns, st.st_size, st.st_ino)
         except OSError:
-            self._disk_stat = None
+            stat = None
+        with self._lock:
+            self._disk_stat = stat
 
     @staticmethod
     def load(path: str) -> "Catalog":

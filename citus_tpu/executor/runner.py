@@ -370,7 +370,10 @@ class Executor:
             if obj.get("version") == self.CAPS_MEMO_VERSION:
                 return {self._memo_from_json(k): self._memo_from_json(v)
                         for k, v in obj["memo"]}
-        except Exception:
+        except (OSError, ValueError, KeyError, TypeError,
+                AttributeError):
+            # unreadable/corrupt memo file (incl. valid JSON that is
+            # not an object — obj.get raises AttributeError): start cold
             pass
         return {}
 
@@ -399,7 +402,7 @@ class Executor:
             with contextlib.suppress(OSError):
                 os.unlink(os.path.join(self.store.data_dir,
                                        "caps_memo.pkl"))
-        except Exception:
+        except (OSError, TypeError, ValueError):
             pass  # persistence is best-effort; in-memory memo suffices
 
     # ------------------------------------------------------------------

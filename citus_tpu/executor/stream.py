@@ -473,7 +473,7 @@ def try_execute_streamed(executor, plan: QueryPlan, raw: bool):
                     return
                 i += 1
             _put(("done", None))
-        except BaseException as e:  # surfaced on the consumer side
+        except BaseException as e:  # graftlint: ignore[swallowed-base-exception] — not swallowed: forwarded over the queue and re-raised on the consumer thread
             _put(("err", e))
 
     t = threading.Thread(target=producer, daemon=True)

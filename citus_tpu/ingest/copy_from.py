@@ -180,6 +180,13 @@ def _ingest_batch(session, table: str, columns: list[str],
     codec = session.settings.get("columnar_compression")
     level = session.settings.get("columnar_compression_level")
     chunk_rows = session.settings.get("columnar_chunk_group_row_limit")
+    # rows per stripe file (ref default 150000): an ingest batch larger
+    # than the limit splits into several stripes, which is what bounds
+    # per-stripe decode/transfer work for the streamed scan path.
+    # (graftlint's config-registry rule found this knob registered,
+    # documented, set by tests — and consumed by nothing.)
+    stripe_limit = max(1, int(session.settings.get(
+        "columnar_stripe_row_limit")))
 
     if meta.method == DistributionMethod.HASH:
         dist_col = meta.distribution_column
@@ -227,10 +234,27 @@ def _ingest_batch(session, table: str, columns: list[str],
                 return None
             sub = {c: typed[c][mask] for c in typed}
             subv = {c: validity[c][mask] for c in validity}
-            rec = session.store.append_stripe(
-                table, s.shard_id, sub, subv, codec=codec, level=level,
-                chunk_rows=chunk_rows, commit=False)
-            return (s.shard_id, rec)
+            n_sub = int(mask.sum())
+            recs = []
+            try:
+                for lo in range(0, n_sub, stripe_limit):
+                    hi = min(n_sub, lo + stripe_limit)
+                    rec = session.store.append_stripe(
+                        table, s.shard_id,
+                        {c: a[lo:hi] for c, a in sub.items()},
+                        {c: a[lo:hi] for c, a in subv.items()},
+                        codec=codec, level=level,
+                        chunk_rows=chunk_rows, commit=False)
+                    recs.append((s.shard_id, rec))
+            except BaseException:
+                # a failure mid-loop must still hand the already-written
+                # (invisible) stripes to the error path's
+                # discard_pending, or their files leak forever
+                # (list.append/extend are GIL-atomic — safe from the
+                # thread pool)
+                pending.extend(recs)
+                raise
+            return recs
 
         try:
             if n >= 65_536 and len(shards) > 1:
@@ -248,7 +272,7 @@ def _ingest_batch(session, table: str, columns: list[str],
                         try:
                             r = f.result()
                             if r is not None:
-                                pending.append(r)
+                                pending.extend(r)
                         except Exception as e:  # keep draining the pool
                             err = err if err is not None else e
                     if err is not None:
@@ -257,7 +281,7 @@ def _ingest_batch(session, table: str, columns: list[str],
                 for i, s in enumerate(shards):
                     r = write_one(i, s)
                     if r is not None:
-                        pending.append(r)
+                        pending.extend(r)
             if commit:
                 session.store.commit_pending(table, pending)
                 pending = []
@@ -271,10 +295,26 @@ def _ingest_batch(session, table: str, columns: list[str],
                 session.locks.release_all(lock_txid)
     else:
         shard = session.catalog.table_shards(table)[0]
-        rec = session.store.append_stripe(
-            table, shard.shard_id, typed, validity, codec=codec,
-            level=level, chunk_rows=chunk_rows, commit=commit)
-        pending = [] if commit else [(shard.shard_id, rec)]
+        # write every stripe invisible, flip the manifest ONCE: a
+        # failure on stripe k must not leave stripes 1..k-1 committed
+        # (the same atomic protocol as the hash path above)
+        pending = []
+        try:
+            for lo in range(0, n, stripe_limit):
+                hi = min(n, lo + stripe_limit)
+                rec = session.store.append_stripe(
+                    table, shard.shard_id,
+                    {c: a[lo:hi] for c, a in typed.items()},
+                    {c: a[lo:hi] for c, a in validity.items()},
+                    codec=codec, level=level, chunk_rows=chunk_rows,
+                    commit=False)
+                pending.append((shard.shard_id, rec))
+            if commit:
+                session.store.commit_pending(table, pending)
+                pending = []
+        except Exception:
+            session.store.discard_pending(table, pending)
+            raise
     if stage_txn:
         session.txn_manager.current.stage_dml(table, {}, pending)
         pending = []

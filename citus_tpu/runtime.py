@@ -61,7 +61,7 @@ def ensure_jax_configured(platform: str | None = None,
                 jax.config.update("jax_compilation_cache_dir", cache_dir)
                 jax.config.update(
                     "jax_persistent_cache_min_compile_time_secs", 1.0)
-        except Exception:
+        except (AttributeError, KeyError, ValueError):
             pass  # older jax without persistent-cache config
     _configured = True
 

@@ -34,7 +34,7 @@ from .errors import (
     UnsupportedQueryError,
 )
 from .planner.bind import Binder, BoundQuery, DictProvider
-from .planner.explain import format_plan
+from .planner.explain import explain_tag, format_plan
 from .planner.plan import DistributedPlanner, QueryPlan, StatsProvider
 from .runtime import ensure_jax_configured
 from .sql import ast, parse
@@ -1391,13 +1391,16 @@ class Session:
                 skipped = self.stats.counters.snapshot().get(
                     sc.CHUNKS_SKIPPED, 0) - skipped0
                 if skipped:
-                    lines.append(f"Chunks Skipped: {skipped}")
+                    lines.append(
+                        f"{explain_tag('Chunks Skipped')}: {skipped}")
                 if result.device_rows_scanned:
-                    lines.append("Device Rows Scanned: "
-                                 f"{result.device_rows_scanned}")
+                    lines.append(
+                        f"{explain_tag('Device Rows Scanned')}: "
+                        f"{result.device_rows_scanned}")
                 if result.streamed_batches:
-                    lines.append("Streamed Execution: "
-                                 f"{result.streamed_batches} batches")
+                    lines.append(
+                        f"{explain_tag('Streamed Execution')}: "
+                        f"{result.streamed_batches} batches")
                 # this statement's deltas (the Chunks Skipped pattern),
                 # plus session totals clearly labeled as such — a clean
                 # statement in a battle-scarred session must not read
@@ -1408,7 +1411,8 @@ class Session:
                 d_f = snap.get(sc.FAILOVERS_TOTAL, 0) - \
                     snap0.get(sc.FAILOVERS_TOTAL, 0)
                 lines.append(
-                    f"Resilience: retries={d_r} failovers={d_f} "
+                    f"{explain_tag('Resilience')}: "
+                    f"retries={d_r} failovers={d_f} "
                     "(session totals: retries_total="
                     f"{snap.get(sc.RETRIES_TOTAL, 0)} failovers_total="
                     f"{snap.get(sc.FAILOVERS_TOTAL, 0)} timeouts_total="
@@ -1420,7 +1424,7 @@ class Session:
                 # the Chunks Skipped pattern), plus session totals so
                 # warm-vs-cold is auditable from one EXPLAIN ANALYZE
                 lines.append(
-                    "Caches: plan-cache hits="
+                    f"{explain_tag('Caches')}: plan-cache hits="
                     f"{pc.hits - cache0[0]} misses="
                     f"{pc.misses - cache0[1]}  feed-cache hits="
                     f"{fc.hits - cache0[2]} misses="
@@ -1436,13 +1440,15 @@ class Session:
                 w_s = snap.get(sc.WLM_SHED_TOTAL, 0)
                 if info is None:
                     lines.append(
-                        "Workload: exempt (fast-path/utility or wlm "
+                        f"{explain_tag('Workload')}: "
+                        "exempt (fast-path/utility or wlm "
                         "disabled) (session totals: wlm_admitted_total="
                         f"{w_adm} wlm_queued_total={w_q} "
                         f"wlm_shed_total={w_s})")
                 else:
                     lines.append(
-                        f"Workload: class={info['priority']} "
+                        f"{explain_tag('Workload')}: "
+                        f"class={info['priority']} "
                         f"tenant={info['tenant']} "
                         f"queued_ms={info['queued_ms']:.1f} "
                         f"slots={info['slots_in_use']}/"

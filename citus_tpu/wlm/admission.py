@@ -28,6 +28,7 @@ fast_path_router_planner.c:530):
 from __future__ import annotations
 
 from ..catalog import Catalog, DistributionMethod
+from ..errors import CatalogError
 from ..sql import ast
 
 # statement kinds that never touch the device path: catalog/host-only
@@ -178,8 +179,8 @@ def planned_feed_bytes(stmt: ast.Statement, catalog: Catalog, store,
             tbytes = sum(store.shard_size_bytes(t, s.shard_id)
                          for s in shards)
             meta = catalog.table(t)
-        except Exception:
-            continue
+        except (CatalogError, OSError, KeyError):
+            continue  # table dropped/moved mid-estimate: skip its bytes
         if meta.method == DistributionMethod.HASH and n_devices > 0:
             total += -(-tbytes // n_devices)
         else:

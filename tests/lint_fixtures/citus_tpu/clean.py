@@ -1,0 +1,52 @@
+"""Fixture: lock usage that must produce NO findings — consistent
+order, guarded writes under the owning lock, a locked helper resolved
+by the call-site fixpoint, and the Condition-aliases-Lock pattern."""
+
+import threading
+
+outer = threading.Lock()
+inner = threading.Lock()
+
+
+def nested_consistent():
+    with outer:
+        with inner:
+            return 1
+
+
+def nested_consistent_again():
+    with outer:
+        with inner:
+            return 2
+
+
+class Guarded:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)  # alias, not a 2nd lock
+        self._items = []
+        self._count = 0
+
+    def put(self, x):
+        with self._cv:
+            self._items.append(x)
+            self._bump()
+
+    def _bump(self):
+        # only ever called under _cv (which IS _lock): fixpoint marks
+        # this helper lock-held, so the write is clean
+        self._count += 1
+
+    def drain_locked(self):
+        # the `_locked` suffix declares the caller-holds-the-lock
+        # convention
+        self._items.clear()
+        return self._count
+
+
+def make_deferred():
+    # the lambda body runs LATER, under its caller's locks — charging
+    # its call to the `inner` with-stack would fabricate an
+    # inner→outer edge and a bogus cycle with nested_consistent
+    with inner:
+        return lambda: nested_consistent()

@@ -1,0 +1,17 @@
+"""Fixture registry: config vars (one read, one dead knob)."""
+
+
+class ConfigVar:
+    def __init__(self, name, default, doc):
+        self.name = name
+
+
+_REGISTRY = {}
+
+
+def _register(var):
+    _REGISTRY[var.name] = var
+
+
+_register(ConfigVar("live_knob", 1, "read by uses.py"))
+_register(ConfigVar("dead_knob", 2, "never read"))   # config-registry

@@ -1,0 +1,23 @@
+"""Fixture: ABBA lock-order cycle across two functions, one of them
+through an interprocedural hop (helper acquires B)."""
+
+import threading
+
+lock_a = threading.Lock()
+lock_b = threading.Lock()
+
+
+def _helper():
+    with lock_b:
+        return 1
+
+
+def forward():
+    with lock_a:
+        return _helper()      # A → B (via the helper)
+
+
+def backward():
+    with lock_b:
+        with lock_a:          # B → A: closes the cycle
+            return 2

@@ -1,0 +1,55 @@
+"""Fixture: every error/resource-discipline rule fires once."""
+
+import os
+import threading
+
+
+def fault_point(name):
+    raise RuntimeError(name)
+
+
+def bare():
+    try:
+        return 1
+    except:                      # bare-except
+        return None
+
+
+def swallow_base():
+    try:
+        return 1
+    except BaseException:        # swallowed-base-exception
+        return None
+
+
+def swallow_seam():
+    try:
+        fault_point("store.x")
+        return 1
+    except Exception:            # swallowed-fault-seam
+        return None
+
+
+def silent():
+    try:
+        return 1
+    except Exception:            # silent-exception
+        pass
+
+
+def orphan_thread():
+    t = threading.Thread(target=silent)   # unowned-thread
+    t.start()
+    # a PATH join must not count as thread ownership
+    return os.path.join("a", "b"), t
+
+
+def owned_threads():
+    # clean: daemon ownership, join ownership, and ','.join is not a
+    # thread join
+    a = threading.Thread(target=silent, daemon=True)
+    a.start()
+    b = threading.Thread(target=silent)
+    b.start()
+    b.join()
+    return ",".join(["x"])

@@ -1,0 +1,49 @@
+"""Fixture: TPU hot-path hygiene violations inside traced functions."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def decorated_traced(x, n):
+    y = np.asarray(x)            # host-sync-in-traced (np call)
+    z = float(x[0])              # host-sync-in-traced (float on value)
+    w = x.sum().item()           # host-sync-in-traced (.item())
+    if jnp.any(x > 0):           # traced-python-branch
+        return y + z + w + n
+    return x
+
+
+def build(mesh):
+    def body(a):
+        return np.sqrt(a)        # host-sync-in-traced (passed to shard_map)
+
+    return shard_map(body, mesh=mesh, in_specs=None, out_specs=None)
+
+
+def shard_map(body, mesh, in_specs, out_specs):
+    return body
+
+
+def churn(fs, xs):
+    out = []
+    for f, x in zip(fs, xs):
+        out.append(jax.jit(f)(x))   # jit-in-loop
+    return out
+
+
+def host_side_is_fine(arr):
+    # clean: not traced — np/float/.item() are host-side here
+    a = np.asarray(arr)
+    b = float(a[0])
+    return a, b, a.sum().item()
+
+
+@functools.partial(jax.jit)
+def traced_while(x):
+    while jnp.any(x > 0):        # traced-python-branch (while)
+        x = x - 1
+    return x

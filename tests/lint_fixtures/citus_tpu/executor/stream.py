@@ -1,0 +1,23 @@
+"""Fixture: blocking transfers inside a streaming loop (module path
+mirrors citus_tpu/executor/stream.py, which the rule scopes to)."""
+
+import jax
+
+
+def drain(batches):
+    out = []
+    for b in batches:
+        out.append(jax.device_get(b))       # device-sync-in-loop
+        b.block_until_ready()               # device-sync-in-loop
+    return out
+
+
+def sanctioned(batches):
+    total = 0
+    for b in batches:
+        total += jax.device_get(b)  # graftlint: ignore[device-sync-in-loop] — fixture: designed per-batch sync point
+    return total
+
+
+def outside_loop(b):
+    return jax.device_get(b)        # clean: not in a loop

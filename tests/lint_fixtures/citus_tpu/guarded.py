@@ -1,0 +1,29 @@
+"""Fixture: unlocked-shared-write (the caps-memo race class) and a raw
+.acquire() that would leak on an exception."""
+
+import threading
+
+
+class Stats:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self.total = 0
+        self.entries = {}
+
+    def record(self, k, v):
+        with self._mu:
+            self.total += v
+            self.entries[k] = v
+
+    def sloppy_bump(self, v):
+        self.total += v            # line 19: guarded field, no lock
+
+    def sloppy_clear(self):
+        self.entries.clear()       # line 22: mutator call, no lock
+
+    def manual(self):
+        self._mu.acquire()         # line 25: raw acquire
+        try:
+            return self.total
+        finally:
+            self._mu.release()

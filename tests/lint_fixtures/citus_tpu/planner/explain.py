@@ -1,0 +1,11 @@
+"""Fixture registry: EXPLAIN tags (one rendered, one dead)."""
+
+EXPLAIN_TAGS = {
+    "Live Tag": "rendered by uses.py",
+    "Dead Tag": "never rendered",        # explain-tag-registry
+}
+
+
+def explain_tag(name):
+    EXPLAIN_TAGS[name]
+    return name

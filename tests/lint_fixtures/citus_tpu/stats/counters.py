@@ -1,0 +1,7 @@
+"""Fixture registry: counters (one used, one dead, one unlisted)."""
+
+ROWS_SEEN = "rows_seen"
+NEVER_BUMPED = "never_bumped"        # in ALL_COUNTERS, no increment
+UNLISTED = "unlisted_counter"        # defined but not in ALL_COUNTERS
+
+ALL_COUNTERS = [ROWS_SEEN, NEVER_BUMPED]

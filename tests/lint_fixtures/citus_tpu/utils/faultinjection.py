@@ -1,0 +1,6 @@
+"""Fixture registry: fault points (one used, one never called)."""
+
+FAULT_POINTS = {
+    "store.x": "discipline_bad.py — used seam",
+    "never.used": "no call site anywhere",   # fault-point-registry
+}

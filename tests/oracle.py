@@ -37,10 +37,46 @@ def make_oracle(tables: dict[str, dict], date_columns: dict[str, list[str]]):
     return conn
 
 
+# RIGHT/FULL JOIN landed in sqlite 3.39; older runtimes (3.34 ships on
+# this sandbox) get a LEFT-JOIN-rewrite fallback instead of 4 permanent
+# tier-1 failures
+_SQLITE_HAS_RIGHT_FULL = sqlite3.sqlite_version_info >= (3, 39, 0)
+
+_RIGHT_RE = re.compile(
+    r"\b(\w+)\s+right\s+(?:outer\s+)?join\s+(\w+)\s+on\b",
+    re.IGNORECASE)
+_FULL_RE = re.compile(
+    r"\bfrom\s+(\w+)\s+full\s+(?:outer\s+)?join\s+(\w+)\s+on\s+(.*?)"
+    r"(?=\s+where\s|\s+group\s+by\b|\s+order\s+by\b|\s+limit\s|\)|$)",
+    re.IGNORECASE | re.DOTALL)
+
+
+def _rewrite_right_full(sql: str) -> str:
+    """sqlite<3.39 fallback for the oracle's test shapes (one RIGHT or
+    FULL join of two base tables):
+
+    * ``A right join B on c`` → ``B left join A on c`` (same rows);
+    * ``from A full join B on c`` → a derived union: the LEFT JOIN
+      rows plus B's unmatched rows (reversed LEFT JOIN filtered to
+      ``A.rowid IS NULL`` — rowid is non-NULL exactly on matches).
+    """
+    sql = _RIGHT_RE.sub(r"\2 left join \1 on", sql)
+
+    def full(m):
+        a, b, cond = m.group(1), m.group(2), m.group(3).strip()
+        return (f"from (select {a}.*, {b}.* from {a} left join {b} "
+                f"on {cond} union all select {a}.*, {b}.* from {b} "
+                f"left join {a} on {cond} where {a}.rowid is null)")
+
+    return _FULL_RE.sub(full, sql)
+
+
 def run_oracle(conn: sqlite3.Connection, sql: str) -> list[tuple]:
     # sqlite doesn't know date/interval literals: rewrite to strings.
     sql = re.sub(r"date\s+'(\d{4}-\d{2}-\d{2})'", r"'\1'", sql,
                  flags=re.IGNORECASE)
+    if not _SQLITE_HAS_RIGHT_FULL:
+        sql = _rewrite_right_full(sql)
     sql = _fold_intervals(sql)
     sql = re.sub(r"extract\s*\(\s*year\s+from\s+(\w+)\s*\)",
                  r"cast(strftime('%Y', \1) as integer)", sql,

@@ -77,6 +77,25 @@ def _read_store(sess) -> dict:
 
 
 def _run_soak(tmp_path, n_ops: int, seed: int, fault_rate: float):
+    # the lock-order sanitizer (graftlint's runtime half) is armed for
+    # every soak: the managers' locks are created inside this scope, so
+    # slot/2PL/manifest/journal acquisition orders across the three
+    # sessions are all order-checked; an inversion raises
+    # LockOrderViolation (an AssertionError — NOT a CitusTpuError), so
+    # it surfaces as an unclean failure and fails the invariant loudly
+    from citus_tpu.analysis import sanitizer
+
+    sanitizer.reset()
+    sanitizer.enable()
+    try:
+        return _run_soak_inner(tmp_path, n_ops, seed, fault_rate)
+    finally:
+        sanitizer.disable()
+        assert sanitizer.violations() == [], \
+            [str(v) for v in sanitizer.violations()]
+
+
+def _run_soak_inner(tmp_path, n_ops: int, seed: int, fault_rate: float):
     rng = random.Random(seed)
     data_dir = str(tmp_path / "chaos")
     mk = lambda: citus_tpu.connect(  # noqa: E731

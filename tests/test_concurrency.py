@@ -128,3 +128,54 @@ def test_parallel_rebalance_moves_not_fully_chained(sess):
         t.depends_on == ((task_ids[i - 1],) if i else ())
         for i, t in enumerate(move_tasks))
     assert not chained or len(move_tasks) <= 1
+
+def test_lock_orders_clean_under_sanitizer(tmp_path):
+    """The graftlint runtime half: two sessions sharing one data_dir
+    (shared WLM/2PL/store managers) run overlapping reads, DML and a
+    transaction with the lock-order sanitizer armed — every lock
+    created in this scope is order-tracked, and any ABBA inversion
+    between the managers raises LockOrderViolation immediately."""
+    from citus_tpu.analysis import sanitizer
+
+    sanitizer.reset()
+    sanitizer.enable()
+    try:
+        d = str(tmp_path / "tsan")
+        s1 = citus_tpu.connect(data_dir=d, n_devices=4,
+                               compute_dtype="float64")
+        s1.execute("create table tz (k bigint, v bigint)")
+        s1.create_distributed_table("tz", "k", shard_count=4)
+        s1.execute("insert into tz values "
+                   + ",".join(f"({i}, {i})" for i in range(1, 301)))
+        s2 = citus_tpu.connect(data_dir=d, n_devices=4,
+                               compute_dtype="float64")
+        errors: list = []
+
+        def worker(s, base):
+            try:
+                for i in range(6):
+                    s.execute(f"select sum(v) from tz where k > {base}")
+                    s.execute(f"update tz set v = v + 1 "
+                              f"where k = {base + i + 1}")
+            except Exception as e:  # pragma: no cover - surfaced below
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker, args=(s, b))
+                   for s, b in ((s1, 0), (s2, 100), (s1, 200))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        s1.execute("begin")
+        s1.execute("update tz set v = 0 where k = 1")
+        s1.execute("commit")
+        assert not errors, errors[0]
+        stats = sanitizer.stats()
+        assert stats["locks_created"] > 0
+        assert stats["acquisitions"] > 100
+        s1.close()
+        s2.close()
+    finally:
+        sanitizer.disable()
+    assert sanitizer.violations() == [], \
+        [str(v) for v in sanitizer.violations()]

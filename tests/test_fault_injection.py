@@ -703,23 +703,18 @@ class TestFaultPointRegistry:
             assert name in out
 
     def test_registry_matches_source_call_sites(self):
-        import glob
+        # thin wrapper over graftlint's registry-sync rule (the ad-hoc
+        # regex scan this test used to carry lives there now, AST-based)
         import os
-        import re
 
-        import citus_tpu
-        from citus_tpu.utils.faultinjection import registered_points
+        from citus_tpu.analysis import run_lint
 
-        pkg = os.path.dirname(citus_tpu.__file__)
-        called = set()
-        for path in glob.glob(os.path.join(pkg, "**", "*.py"),
-                              recursive=True):
-            with open(path) as f:
-                called.update(re.findall(r'fault_point\("([^"]+)"\)',
-                                         f.read()))
-        called.discard("name")  # the definition site's own docstring
-        assert called == set(registered_points()), (
-            "fault-point registry out of sync with source call sites")
+        root = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        findings = run_lint(root, rules=("fault-point-registry",))
+        assert not findings, (
+            "fault-point registry out of sync with source call sites:\n"
+            + "\n".join(str(f) for f in findings))
 
     def test_every_registered_point_armed_by_a_test(self):
         import glob
