@@ -21,6 +21,7 @@ from .errors import (
     CatalogError,
     CitusTpuError,
     ConfigError,
+    CorruptStripe,
     ExecutionError,
     IngestError,
     ParseError,
@@ -48,7 +49,8 @@ __version__ = "0.1.0"
 __all__ = [
     "Settings", "registered_vars", "ColumnDef", "DataType", "TableSchema",
     "sql_type_to_datatype", "CitusTpuError", "ConfigError", "CatalogError",
-    "StorageError", "ParseError", "PlanningError", "UnsupportedQueryError",
+    "StorageError", "CorruptStripe", "ParseError", "PlanningError",
+    "UnsupportedQueryError",
     "ExecutionError", "CapacityOverflowError", "IngestError",
     "TransactionError", "QueryCanceled", "StatementTimeout",
     "AdmissionRejected",

@@ -80,7 +80,7 @@ def main(argv: list[str] | None = None) -> int:
 
     if args.write_baseline:
         payload = baseline_payload(findings, baseline)
-        with open(baseline_path, "w", encoding="utf-8") as f:
+        with open(baseline_path, "w", encoding="utf-8") as f:  # graftlint: ignore[raw-durable-write] — lint baseline, not data-dir durable state
             json.dump(payload, f, indent=1)
             f.write("\n")
         print(f"wrote {len(findings)} finding(s) to {baseline_path}")

@@ -140,7 +140,7 @@ FAMILY_RULES = {
                              "config-registry", "explain-tag-registry"}),
     "discipline": frozenset({"bare-except", "swallowed-base-exception",
                              "swallowed-fault-seam", "silent-exception",
-                             "unowned-thread"}),
+                             "unowned-thread", "raw-durable-write"}),
 }
 
 

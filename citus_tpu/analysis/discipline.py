@@ -19,6 +19,14 @@
   ``daemon=True`` and without a reachable ``.join()`` in the same
   function: a non-daemon thread nobody joins keeps the process alive
   after the session closes.
+* ``raw-durable-write`` — ``os.replace`` / ``os.fsync`` / ``open``
+  with a writable mode anywhere in ``citus_tpu/`` outside the
+  ``utils/io`` durable-write seam (and its crash shim): a writer that
+  bypasses the seam silently loses the tmp+fsync+rename+dir-fsync
+  discipline, the embedded checksums AND the power-cut torture
+  harness's interception point.  Genuinely non-durable writes (build
+  artifacts, lint baselines) justify themselves inline or in the
+  baseline.
 """
 
 from __future__ import annotations
@@ -28,6 +36,24 @@ import ast
 from .core import Finding, Module, qualname_of
 
 _BROAD = ("Exception", "BaseException")
+
+# the sanctioned home of raw durable-write primitives: the shared
+# helper seam itself, plus the crash shim that simulates torn disks
+_IO_SEAM = ("citus_tpu/utils/io.py", "citus_tpu/utils/crashsim.py")
+
+
+def _is_write_mode(node: ast.Call) -> bool:
+    """open(...) with a literal mode containing w/a/+/x."""
+    mode = None
+    if len(node.args) >= 2:
+        mode = node.args[1]
+    for kw in node.keywords:
+        if kw.arg == "mode":
+            mode = kw.value
+    if not (isinstance(mode, ast.Constant)
+            and isinstance(mode.value, str)):
+        return False
+    return any(c in mode.value for c in "wa+x")
 
 
 def _handler_names(h: ast.ExceptHandler) -> list[str]:
@@ -111,6 +137,7 @@ class _Visitor(ast.NodeVisitor):
 
     def visit_Call(self, node: ast.Call) -> None:
         fn = node.func
+        self._check_raw_durable_write(node, fn)
         is_thread_ctor = (
             isinstance(fn, ast.Attribute) and fn.attr == "Thread"
             and isinstance(fn.value, ast.Name)
@@ -128,6 +155,27 @@ class _Visitor(ast.NodeVisitor):
                            "with no .join() in this function — nobody "
                            "owns its shutdown")
         self.generic_visit(node)
+
+    def _check_raw_durable_write(self, node: ast.Call, fn) -> None:
+        if not self.mod.relpath.startswith("citus_tpu/") or \
+                self.mod.relpath in _IO_SEAM:
+            return
+        if isinstance(fn, ast.Attribute) and \
+                isinstance(fn.value, ast.Name) and fn.value.id == "os" \
+                and fn.attr in ("replace", "fsync"):
+            self._flag("raw-durable-write", node,
+                       f"os.{fn.attr}() outside utils/io — route the "
+                       "write through the durable-write seam "
+                       "(atomic_write_* / atomic_stream_writer) so "
+                       "fsync discipline, checksums and the crash shim "
+                       "all apply")
+            return
+        if isinstance(fn, ast.Name) and fn.id == "open" and \
+                _is_write_mode(node):
+            self._flag("raw-durable-write", node,
+                       "open() for writing outside utils/io — durable "
+                       "state must go through the atomic-write seam; "
+                       "justify genuinely non-durable writers inline")
 
     def _joined_nearby(self) -> bool:
         """The enclosing function (or class, for threads stored on self

@@ -30,12 +30,15 @@ class MaintenanceDaemon:
         self._last_cleanup = 0.0
         self._last_deadlock = 0.0
         self._last_health = 0.0
+        self._last_scrub = 0.0
         # observability: how many times each duty ran
         self.recover_runs = 0
         self.cleanup_runs = 0
         self.deadlock_checks = 0
         self.health_sweeps = 0
         self.nodes_disabled = 0
+        self.scrub_runs = 0
+        self.scrub_repairs = 0
 
     def start(self) -> None:
         if self._thread is not None and self._thread.is_alive():
@@ -45,6 +48,7 @@ class MaintenanceDaemon:
         now = time.monotonic()
         self._last_recover = self._last_cleanup = self._last_deadlock = now
         self._last_health = now
+        self._last_scrub = now
         self._stop.clear()
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="citus-tpu-maintenanced")
@@ -64,6 +68,7 @@ class MaintenanceDaemon:
                 self._maybe_cleanup(now)
                 self._maybe_deadlock_check(now)
                 self._maybe_health_sweep(now)
+                self._maybe_scrub(now)
             except Exception:
                 # the daemon must survive transient errors (the reference
                 # daemon catches and retries on its next wakeup)
@@ -95,6 +100,20 @@ class MaintenanceDaemon:
         disabled = health_sweep(self.session)
         self.health_sweeps += 1
         self.nodes_disabled += len(disabled)
+
+    def _maybe_scrub(self, now: float) -> None:
+        """Storage scrub (operations/scrubber.py): verify every
+        placement copy's checksums, quarantine + re-replicate corrupt
+        ones — the built-in pg_checksums-from-cron."""
+        iv = self._interval("scrub_interval_ms")
+        if iv is None or now - self._last_scrub < iv:
+            return
+        self._last_scrub = now
+        from ..operations.scrubber import scrub_session
+
+        rep = scrub_session(self.session, background=False)
+        self.scrub_runs += 1
+        self.scrub_repairs += rep.repaired
 
     def _maybe_cleanup(self, now: float) -> None:
         iv = self._interval("defer_shard_delete_interval_ms")

@@ -19,7 +19,6 @@ Differences driven by the TPU architecture:
 from __future__ import annotations
 
 import enum
-import json
 import threading
 from dataclasses import dataclass
 from typing import Iterable
@@ -164,6 +163,11 @@ class Catalog:
         self.views: dict[str, dict] = {}
         self.version = 0
         self._disk_stat = None  # (mtime_ns, size) of the persisted file
+        # shard_id → [ShardPlacement] cache (any state), rebuilt lazily
+        # after a _bump: the storage integrity path resolves physical
+        # copies through shard_placements several times per stripe read,
+        # and a full placements scan per call is O(stripes × placements)
+        self._by_shard: dict[int, list[ShardPlacement]] | None = None
         # placements the statement retry loop observed failing a shard
         # read: active_placement prefers non-suspect replicas so the
         # retry lands elsewhere (in-memory, this process only — the
@@ -185,6 +189,22 @@ class Catalog:
     # -- mutation helpers --------------------------------------------------
     def _bump(self):
         self.version += 1
+        self._by_shard = None
+
+    def _shard_index_locked(self) -> dict[int, list[ShardPlacement]]:
+        """shard_id → placements (every state, placement_id-sorted).
+        Callers hold self._lock.  Sound because EVERY placement mutation
+        — adds, drops, state flips, and the maybe_reload dict swap —
+        happens under the lock and ends in _bump()."""
+        idx = self._by_shard
+        if idx is None:
+            idx = {}
+            for p in self.placements.values():
+                idx.setdefault(p.shard_id, []).append(p)
+            for ps in idx.values():
+                ps.sort(key=lambda p: p.placement_id)
+            self._by_shard = idx
+        return idx
 
     def allocate_shard_id(self) -> int:
         with self._lock:
@@ -435,12 +455,31 @@ class Catalog:
 
     def shard_placements(self, shard_id: int) -> list[ShardPlacement]:
         with self._lock:
-            return sorted((p for p in self.placements.values()
-                           if p.shard_id == shard_id
-                           and p.shard_state == "active"),
-                          key=lambda p: p.placement_id)
+            return [p for p in self._shard_index_locked().get(shard_id, ())
+                    if p.shard_state == "active"]
 
-    def active_placement(self, shard_id: int) -> ShardPlacement:
+    def all_shard_placements(self, shard_id: int) -> list[ShardPlacement]:
+        """Every placement of a shard regardless of state (quarantined /
+        to_delete included) — physical-copy attribution for the
+        integrity path, NOT routing."""
+        with self._lock:
+            return list(self._shard_index_locked().get(shard_id, ()))
+
+    def set_placement_state(self, placement_id: int, state: str) -> None:
+        """Scrubber quarantine/restore: a 'quarantined' placement drops
+        out of shard_placements (and so out of routing and replication
+        guarantees) until re-replication verifies its copy and restores
+        it to 'active'."""
+        with self._lock:
+            p = self.placements.get(placement_id)
+            if p is None:
+                raise CatalogError(
+                    f"placement {placement_id} does not exist")
+            p.shard_state = state
+            self._bump()
+
+    def active_placement(self, shard_id: int,
+                         probe: bool = True) -> ShardPlacement:
         """Primary placement for reads: the lowest-id active placement
         whose NODE is alive.  With replicated placements this IS the
         read failover — disabling a node silently shifts every affected
@@ -448,10 +487,14 @@ class Catalog:
         into task execution instead, adaptive_executor.c:95-116).
         Placements the retry loop marked suspect are deprioritized, not
         excluded: when every replica is suspect the first live one still
-        answers (a wrong routing beats an unroutable shard)."""
-        from ..utils.faultinjection import fault_point
+        answers (a wrong routing beats an unroutable shard).
+        `probe=False` skips the fault-point seam — the storage layer
+        resolves physical copy paths through here several times per
+        statement and must not multiply an armed probe fault."""
+        if probe:
+            from ..utils.faultinjection import fault_point
 
-        fault_point("catalog.placement_probe")
+            fault_point("catalog.placement_probe")
         ps = self.shard_placements(shard_id)
         live = [p for p in ps
                 if (n := self.nodes.get(p.node_id)) is not None
@@ -484,6 +527,12 @@ class Catalog:
                   and (n := self.nodes.get(q.node_id)) is not None
                   and n.is_active]
         return bool(others)
+
+    def clear_placement_suspect(self, placement_id: int) -> None:
+        """Forget suspicion of ONE placement (scrubber repair verified
+        its physical copy again)."""
+        with self._lock:
+            self._suspect_placements.discard(placement_id)
 
     def clear_placement_suspects(self, node_id: int | None = None) -> None:
         """Forget suspicion (all placements, or one recovered node's)."""
@@ -640,9 +689,9 @@ class Catalog:
         """Atomic durable write — the catalog's durability primitive."""
         import os
 
-        from ..utils.io import atomic_write_json
+        from ..utils.io import atomic_write_json_checked
 
-        atomic_write_json(path, self.to_json())
+        atomic_write_json_checked(path, self.to_json())
         # _disk_stat is read/written under _lock by maybe_reload (the
         # staleness probe); writing it bare here let a concurrent
         # reload adopt a stat for bytes it hadn't merged yet
@@ -658,8 +707,9 @@ class Catalog:
     def load(path: str) -> "Catalog":
         import os
 
-        with open(path) as f:
-            cat = Catalog.from_json(json.load(f))
+        from ..utils.io import read_json_checked
+
+        cat = Catalog.from_json(read_json_checked(path))
         try:
             st = os.stat(path)
             cat._disk_stat = (st.st_mtime_ns, st.st_size, st.st_ino)

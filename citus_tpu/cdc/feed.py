@@ -157,7 +157,10 @@ class ChangeLog:
             # most the in-flight commit's events (at-most-once window),
             # never corrupt earlier lines
             fault_point("cdc.append")
-            with open(self.path, "a") as f:
+            # the journal MUST hold one handle across flock + lsn
+            # allocation + append, so it cannot ride an io helper; the
+            # crash shim intercepts via dio.append_op below instead
+            with open(self.path, "a") as f:  # graftlint: ignore[raw-durable-write] — flock+lsn+append need one handle; crash seam is dio.append_op
                 # exclusive journal lock: concurrent sessions (threads or
                 # processes) serialize their appends and allocate from
                 # ONE lsn sequence
@@ -190,9 +193,16 @@ class ChangeLog:
                                     lead = "\n"
                         except OSError:
                             pass  # empty file: nothing to isolate
-                    f.write(lead + "\n".join(payload) + "\n")
+                    data = lead + "\n".join(payload) + "\n"
+                    # crash seam: the shim counts this append and can
+                    # drop or tear its tail (readers tolerate torn
+                    # trailing lines — see read()/_scan_next_lsn)
+                    from ..utils import io as dio
+
+                    dio.append_op(self.path, data.encode())
+                    f.write(data)
                     f.flush()
-                    os.fsync(f.fileno())
+                    os.fsync(f.fileno())  # graftlint: ignore[raw-durable-write] — same single-handle append as the open above; seam is dio.append_op
                     self._expected_size = f.tell()
                 finally:
                     fcntl.flock(f.fileno(), fcntl.LOCK_UN)

@@ -266,6 +266,32 @@ _register(ConfigVar(
     "Codec level (ref: columnar.compression_level).",
     int, min_value=1, max_value=19))
 
+# --- durability & integrity (PostgreSQL data_checksums analogue) -----------
+_register(ConfigVar(
+    "storage_verify_checksums", True,
+    "Verify stripe chunk/footer CRC32s on every read; a mismatch raises "
+    "CorruptStripe and the read transparently repairs from a surviving "
+    "replica copy when shard_replication_factor >= 2 (ref: PostgreSQL "
+    "data_checksums, which Citus inherits per node). Off skips the CRC "
+    "pass (structural checks only) — measurement knob, not a production "
+    "mode.",
+    bool))
+_register(ConfigVar(
+    "scrub_interval_ms", -1,
+    "Maintenance-daemon storage scrub: periodically verify every "
+    "placement copy's checksums, quarantine corrupt placements and "
+    "re-replicate them from a verified copy (operations/scrubber.py); "
+    "-1 disables (run on demand via citus_check_cluster()). No direct "
+    "reference GUC — the closest analogue is running pg_checksums/"
+    "amcheck from cron.",
+    int, min_value=-1, max_value=86_400_000))
+_register(ConfigVar(
+    "scrub_temp_max_age_s", 300.0,
+    "Age floor before the scrubber removes orphan temp files (.tmp / "
+    ".aw.*) left by crashes — young temps may belong to an in-flight "
+    "writer in another session.",
+    float, min_value=0.0, max_value=86_400.0))
+
 # --- ingest ---------------------------------------------------------------
 _register(ConfigVar(
     "copy_pipeline", True,

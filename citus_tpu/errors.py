@@ -34,6 +34,18 @@ class StorageError(CitusTpuError):
     shard_id: int | None = None
 
 
+class CorruptStripe(StorageError):
+    """On-disk integrity violation: a stripe/manifest checksum mismatch,
+    torn tail, or structural damage detected by the end-to-end CRC path
+    (storage/format.py v2 footers, storage/integrity.py).
+
+    Subclasses StorageError so the PR-3 resilience machinery classifies
+    it as a placement failure: the read path marks the owning placement
+    suspect and re-routes onto a surviving replica copy (the
+    data_checksums + ereport(ERROR) analogue — wrong bytes are NEVER
+    returned as data)."""
+
+
 class ParseError(CitusTpuError):
     """SQL syntax error."""
 

@@ -44,7 +44,7 @@ def _build_and_load():
             # no libzstd on this host: zstd chunks fall back to Python
             subprocess.run(base + ["-DNO_ZSTD"], check=True,
                            capture_output=True, timeout=120)
-        os.replace(tmp, so)
+        os.replace(tmp, so)  # graftlint: ignore[raw-durable-write] — compiler build artifact beside the sources, not data-dir state
     lib = ctypes.CDLL(so)
     lib.ct_string_hash_tokens.restype = None
     lib.ct_string_hash_tokens.argtypes = [

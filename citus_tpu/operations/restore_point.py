@@ -22,7 +22,8 @@ from __future__ import annotations
 import os
 import shutil
 
-from ..errors import CatalogError
+from ..errors import CatalogError, CorruptStripe
+from ..utils.io import is_tmp_artifact
 
 
 def _restore_dir(data_dir: str, name: str) -> str:
@@ -73,11 +74,15 @@ def create_restore_point(session, name: str) -> str:
                 if os.path.isdir(src):  # shard dir: hardlink data files
                     os.makedirs(dst)
                     for f in sorted(os.listdir(src)):
-                        if f.endswith(".tmp"):
+                        # skip every durable-write tmp shape (stream
+                        # tmps are `*.tmp.<pid>.<tid>`): another
+                        # session may be streaming a stripe right now
+                        # and its torn tmp must not enter the snapshot
+                        if is_tmp_artifact(f):
                             continue
                         _link_or_copy(os.path.join(src, f),
                                       os.path.join(dst, f))
-                elif not entry.endswith(".tmp"):
+                elif not is_tmp_artifact(entry):
                     shutil.copy2(src, dst)  # manifest / dict files
     os.rename(tmp, dest)
     return name
@@ -90,16 +95,59 @@ def list_restore_points(data_dir: str) -> list[str]:
     return sorted(p for p in os.listdir(root) if not p.endswith(".tmp"))
 
 
+def verify_restore_point(src: str) -> int:
+    """Full integrity pass over a snapshot BEFORE it may replace live
+    data: the catalog and every manifest must parse with valid embedded
+    CRCs, every stripe file a manifest references must exist and pass
+    the complete footer+chunk CRC verification, every deletion bitmap
+    must load.  Raises CorruptStripe naming the damage; returns the
+    number of stripe files verified."""
+    from ..storage import integrity
+    from ..utils.io import read_json_checked
+
+    cat_path = os.path.join(src, "catalog.json")
+    if os.path.exists(cat_path):
+        read_json_checked(cat_path)
+    verified = 0
+    tables_root = os.path.join(src, "tables")
+    for table in (sorted(os.listdir(tables_root))
+                  if os.path.isdir(tables_root) else []):
+        tdir = os.path.join(tables_root, table)
+        man_path = os.path.join(tdir, "MANIFEST.json")
+        if not os.path.exists(man_path):
+            continue
+        man = read_json_checked(man_path)
+        for sid, records in man.get("shards", {}).items():
+            sdir = os.path.join(tdir, f"shard_{sid}")
+            for rec in records:
+                spath = os.path.join(sdir, rec["file"])
+                if not os.path.exists(spath):
+                    raise CorruptStripe(
+                        f"restore point is damaged: {table}/shard {sid}"
+                        f"/{rec['file']} referenced by the manifest is "
+                        "missing from the snapshot")
+                integrity.verify_stripe_file(spath)
+                verified += 1
+                if rec.get("deletes"):
+                    # CRC + structural load; raises CorruptStripe
+                    integrity.read_mask(os.path.join(sdir,
+                                                     rec["deletes"]))
+    return verified
+
+
 def restore_cluster(data_dir: str, name: str) -> None:
     """Roll a data directory back to a restore point.
 
     Out-of-band like the reference's PITR: run with NO live session on
     the directory, then open a fresh Session.  Current state is replaced
     wholesale; stripes restore as hardlinks (immutable, so sharing is
-    safe)."""
+    safe).  The snapshot is checksum-verified FIRST — a damaged restore
+    point refuses cleanly with live data untouched (the old behavior
+    wiped live tables before looking at the snapshot)."""
     src = _restore_dir(data_dir, name)
     if not os.path.isdir(src):
         raise CatalogError(f"unknown restore point {name!r}")
+    verify_restore_point(src)
     # replace live metadata + table trees with the snapshot's
     for fname in ("catalog.json", "cleanup.json", "cdc_changes.jsonl"):
         live = os.path.join(data_dir, fname)

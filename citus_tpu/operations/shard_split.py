@@ -103,6 +103,12 @@ def split_shard_by_split_points(session, shard_id: int,
             store.refresh(t)
             _rewrite_shard(session, t, plan[t]["parent"],
                            plan[t]["children"], los, his)
+        from ..utils.faultinjection import fault_point
+
+        # named seam: every child stripe is written but the catalog
+        # commit has not happened — a kill here must leave the parent
+        # authoritative and the children invisible (cleanup-swept)
+        fault_point("operations.shard_split")
         # --- atomic commit point: one catalog mutation + save ---
         with catalog._lock:
             for t in group_tables:
@@ -164,7 +170,8 @@ def _restore_catalog(catalog, snapshot: dict) -> None:
         catalog.placements = restored.placements
         catalog.nodes = restored.nodes
         catalog.colocation_groups = restored.colocation_groups
-        catalog.version = restored.version + 1  # invalidate cached plans
+        catalog.version = restored.version  # _bump invalidates cached plans
+        catalog._bump()  # ... and the _by_shard placement index
         catalog._next_shard_id = max(catalog._next_shard_id,
                                      restored._next_shard_id)
         catalog._next_placement_id = max(catalog._next_placement_id,

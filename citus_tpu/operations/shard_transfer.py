@@ -55,3 +55,23 @@ def move_shard_placement(catalog: Catalog, store: TableStore,
             moved.append(s.shard_id)
         catalog._bump()
     return moved
+
+
+def repair_shard_placement(catalog: Catalog, placement,
+                           source_path: str, dest_path: str) -> None:
+    """Re-replicate one damaged physical copy: rewrite `dest_path` from
+    the verified `source_path` (atomic + durable), verify the rewrite,
+    then restore the placement to `active` and clear its suspect mark —
+    the data plane of the scrubber's self-healing (the reference
+    re-creates a broken placement by copying from a healthy one,
+    operations/shard_transfer.c; immutable stripes make it one file
+    copy)."""
+    from ..storage import integrity
+    from ..utils import io as dio
+
+    dio.copy_file_durable(source_path, dest_path)
+    integrity.verify_stripe_file(dest_path)  # the repair itself verifies
+    if placement is not None:
+        if placement.shard_state == "quarantined":
+            catalog.set_placement_state(placement.placement_id, "active")
+        catalog.clear_placement_suspect(placement.placement_id)

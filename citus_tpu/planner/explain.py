@@ -46,6 +46,7 @@ EXPLAIN_TAGS: dict[str, str] = {
     "Streamed Execution": "scan ran via the batched stream pipeline",
     "Device Rows Scanned": "result-transfer volume in row slots",
     "Resilience": "retry/failover totals for this statement",
+    "Integrity": "stripes CRC-verified / read-repaired this statement",
     "Caches": "plan/feed cache traffic for this statement",
     "Workload": "admission-gate trip for this statement",
 }

@@ -55,6 +55,7 @@ _UDFS = ("create_distributed_table", "create_reference_table",
          "citus_job_wait", "citus_job_cancel", "citus_job_list",
          "citus_change_feed", "citus_create_restore_point",
          "citus_check_cluster_node_health", "citus_promote_node",
+         "citus_check_cluster",
          "nextval", "currval",
          "citus_tables", "citus_shards")
 
@@ -112,7 +113,8 @@ class Session:
         cat_path = os.path.join(self.data_dir, "catalog.json")
         self.catalog = (Catalog.load(cat_path) if os.path.exists(cat_path)
                         else Catalog())
-        self.store = TableStore(self.data_dir, self.catalog)
+        self.store = TableStore(self.data_dir, self.catalog,
+                                self.settings)
         from .distributed.mesh import SHARD_AXIS, make_mesh
 
         if mesh is not None:
@@ -198,10 +200,14 @@ class Session:
             self.catalog.maybe_reload(
                 os.path.join(self.data_dir, "catalog.json"))
         self._cancel_evt.clear()  # a fresh script clears stale cancels
+        from .stats import counters as sc
+        from .storage import integrity as _integrity
+
         with self.stats.activity.track(sql) as activity:
             t0 = _time.perf_counter()
             for stmt in parse(sql):
                 activity.retries = 0
+                activity.read_repairs = 0
                 # per-STATEMENT snapshot (like the retries reset): the
                 # citus_stat_activity cache columns show the in-flight
                 # statement's own traffic, not the whole script's
@@ -209,7 +215,25 @@ class Session:
                                        self.executor.plan_cache.misses,
                                        self.executor.feed_cache.hits,
                                        self.executor.feed_cache.misses)
-                result = self._execute_admitted(stmt, activity)
+                ibase = _integrity.snapshot()
+                try:
+                    result = self._execute_admitted(stmt, activity)
+                finally:
+                    # fold this statement's storage-integrity traffic
+                    # (module-wide accounting, like faults_injected)
+                    # into the session counters + the activity row
+                    idelta = _integrity.delta(ibase)
+                    c = self.stats.counters
+                    if idelta["stripes_verified"]:
+                        c.increment(sc.STRIPES_VERIFIED_TOTAL,
+                                    idelta["stripes_verified"])
+                    if idelta["corruption_detected"]:
+                        c.increment(sc.CORRUPTION_DETECTED_TOTAL,
+                                    idelta["corruption_detected"])
+                    if idelta["read_repairs"]:
+                        c.increment(sc.READ_REPAIRS_TOTAL,
+                                    idelta["read_repairs"])
+                        activity.read_repairs += idelta["read_repairs"]
                 self._count_statement(stmt, result)
                 tenant_hits.extend(extract_tenants(stmt, self.catalog))
             elapsed_ms = (_time.perf_counter() - t0) * 1000.0
@@ -769,6 +793,28 @@ class Session:
                 {"node_name": [r[0] for r in rows],
                  "is_active": [r[1] for r in rows],
                  "healthy": [r[2] for r in rows]}, len(rows))
+        elif e.name == "citus_check_cluster":
+            # storage scrub behind a UDF (amcheck/pg_checksums analogue,
+            # run as a background job): verify every placement copy,
+            # quarantine + re-replicate corrupt ones, GC crash debris.
+            # Optional arg: temp-file age floor in seconds (default:
+            # scrub_temp_max_age_s).
+            from .operations.scrubber import scrub_session
+
+            age = float(args[0]) if args else None
+            rep = scrub_session(self, temp_max_age_s=age)
+            return ResultSet(
+                ["stripes_verified", "masks_verified", "corrupt_copies",
+                 "quarantined", "repaired", "unrepairable",
+                 "temps_removed", "replica_dirs_removed"],
+                {"stripes_verified": [rep.stripes_verified],
+                 "masks_verified": [rep.masks_verified],
+                 "corrupt_copies": [rep.corrupt_copies],
+                 "quarantined": [rep.quarantined],
+                 "repaired": [rep.repaired],
+                 "unrepairable": [rep.unrepairable],
+                 "temps_removed": [rep.temps_removed],
+                 "replica_dirs_removed": [rep.replica_dirs_removed]}, 1)
         elif e.name == "citus_promote_node":
             # node_promotion.c analogue: demote a dead node's placements
             # so every shard's surviving replica becomes its primary
@@ -893,7 +939,7 @@ class Session:
 
             return ResultSet(
                 ["global_pid", "query", "state", "wait_state",
-                 "queued_ms", "retries",
+                 "queued_ms", "retries", "read_repairs",
                  "plan_cache_hits", "plan_cache_misses",
                  "feed_cache_hits", "feed_cache_misses"],
                 {"global_pid": [a.gpid for a in entries],
@@ -902,6 +948,7 @@ class Session:
                  "wait_state": [a.wait_state for a in entries],
                  "queued_ms": [round(a.queued_ms, 3) for a in entries],
                  "retries": [a.retries for a in entries],
+                 "read_repairs": [a.read_repairs for a in entries],
                  "plan_cache_hits": [delta(a, 0) for a in entries],
                  "plan_cache_misses": [delta(a, 1) for a in entries],
                  "feed_cache_hits": [delta(a, 2) for a in entries],
@@ -1377,10 +1424,13 @@ class Session:
 
                 from .stats import counters as sc
 
+                from .storage import integrity as _integrity
+
                 snap0 = self.stats.counters.snapshot()
                 skipped0 = snap0.get(sc.CHUNKS_SKIPPED, 0)
                 pc, fc = self.executor.plan_cache, self.executor.feed_cache
                 cache0 = (pc.hits, pc.misses, fc.hits, fc.misses)
+                ibase0 = _integrity.snapshot()
                 t0 = time.perf_counter()
                 result = self.executor.execute_plan(plan)
                 elapsed = time.perf_counter() - t0
@@ -1410,6 +1460,25 @@ class Session:
                     snap0.get(sc.RETRIES_TOTAL, 0)
                 d_f = snap.get(sc.FAILOVERS_TOTAL, 0) - \
                     snap0.get(sc.FAILOVERS_TOTAL, 0)
+                # storage integrity: what THIS execution verified /
+                # repaired (deltas of the module-wide accounting), plus
+                # session totals like the Resilience line
+                idelta = _integrity.delta(ibase0)
+                # this statement's integrity traffic folds into the
+                # session counters only after _execute_admitted returns
+                # (execute()'s finally), so add it here — the totals
+                # must include the statement being explained
+                sv_total = (snap.get(sc.STRIPES_VERIFIED_TOTAL, 0)
+                            + idelta["stripes_verified"])
+                rr_total = (snap.get(sc.READ_REPAIRS_TOTAL, 0)
+                            + idelta["read_repairs"])
+                lines.append(
+                    f"{explain_tag('Integrity')}: stripes verified="
+                    f"{idelta['stripes_verified']} read repairs="
+                    f"{idelta['read_repairs']} corruption detected="
+                    f"{idelta['corruption_detected']} (session totals: "
+                    f"stripes_verified_total={sv_total} "
+                    f"read_repairs_total={rr_total})")
                 lines.append(
                     f"{explain_tag('Resilience')}: "
                     f"retries={d_r} failovers={d_f} "

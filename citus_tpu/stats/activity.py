@@ -32,6 +32,9 @@ class ActivityEntry:
     # resilient executor bumps this so citus_stat_activity shows which
     # live statements are riding out transient failures)
     retries: int = 0
+    # stripe reads this statement transparently served from a replica
+    # copy after a checksum failure (storage/integrity.py fold)
+    read_repairs: int = 0
     # (plan_hits, plan_misses, feed_hits, feed_misses) snapshot of the
     # session executor's cache counters when the statement started;
     # citus_stat_activity subtracts it from the live totals to show

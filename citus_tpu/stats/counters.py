@@ -49,6 +49,13 @@ WLM_ADMITTED_TOTAL = "wlm_admitted_total"
 WLM_QUEUED_TOTAL = "wlm_queued_total"
 WLM_SHED_TOTAL = "wlm_shed_total"
 WLM_QUEUE_WAIT_MS = "wlm_queue_wait_ms"
+# storage integrity (storage/integrity.py read-path accounting folded
+# in per statement; scrub counters from operations/scrubber.py)
+STRIPES_VERIFIED_TOTAL = "stripes_verified_total"
+CORRUPTION_DETECTED_TOTAL = "corruption_detected_total"
+READ_REPAIRS_TOTAL = "read_repairs_total"
+SCRUB_RUNS_TOTAL = "scrub_runs_total"
+SCRUB_REPAIRS_TOTAL = "scrub_repairs_total"
 
 ALL_COUNTERS = [
     QUERIES_SINGLE_SHARD, QUERIES_MULTI_SHARD, QUERIES_REPARTITION,
@@ -62,6 +69,8 @@ ALL_COUNTERS = [
     FAULTS_INJECTED_TOTAL,
     WLM_ADMITTED_TOTAL, WLM_QUEUED_TOTAL, WLM_SHED_TOTAL,
     WLM_QUEUE_WAIT_MS,
+    STRIPES_VERIFIED_TOTAL, CORRUPTION_DETECTED_TOTAL,
+    READ_REPAIRS_TOTAL, SCRUB_RUNS_TOTAL, SCRUB_REPAIRS_TOTAL,
 ]
 
 

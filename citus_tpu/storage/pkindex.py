@@ -55,11 +55,15 @@ def _build(store, table: str, shard_id: int, column: str, records):
     storage_col = store.storage_column_name(table, column)
     keys_parts, sidx_parts, pos_parts = [], [], []
     for i, rec in enumerate(records):
-        path = os.path.join(store.shard_dir(table, shard_id), rec["file"])
-        reader = StripeReader(path)
-        if storage_col not in reader._by_name:
-            continue  # pre-ALTER stripe: column reads as all-NULL
-        vals, mask, n = reader.read([storage_col])
+        def read_one(path):
+            reader = StripeReader(path, verify=store._verify_enabled())
+            if storage_col not in reader._by_name:
+                return None  # pre-ALTER stripe: column reads all-NULL
+            return reader.read([storage_col])
+        got = store.verified_read(table, shard_id, rec["file"], read_one)
+        if got is None:
+            continue
+        vals, mask, n = got
         v = np.asarray(vals[storage_col]).astype(np.int64)
         m = np.asarray(mask[storage_col])  # validity: NULL keys excluded
         pos = np.flatnonzero(m)
@@ -115,21 +119,19 @@ def lookup(store, table: str, shard_id: int, column: str,
                                       records)
             try:
                 os.makedirs(os.path.dirname(path), exist_ok=True)
-                # per-writer tmp name: two sessions rebuilding the same
-                # stale index concurrently must not interleave writes
-                # into ONE tmp file and os.replace a torn npz — each
-                # writer publishes its own complete file atomically.
-                # Sessions are in-process objects, so the writer id
-                # needs the THREAD, not just the pid.
-                import threading as _threading
+                # atomic-rename publish via the shared durable-write
+                # seam (utils/io): concurrent rebuilders each publish a
+                # complete file, and the crash shim sees the write
+                import io as pyio
 
-                tmp = (f"{path}.tmp.{os.getpid()}."
-                       f"{_threading.get_ident()}.npz")
+                from ..utils import io as dio
+
+                buf = pyio.BytesIO()
                 files = np.asarray([f for f, _r in sig])
                 rows = np.asarray([r for _f, r in sig], dtype=np.int64)
-                np.savez(tmp, keys=keys, stripe_idx=sidx, row_pos=rpos,
+                np.savez(buf, keys=keys, stripe_idx=sidx, row_pos=rpos,
                          sig_files=files, sig_rows=rows)
-                os.replace(tmp, path)
+                dio.atomic_write_bytes(path, buf.getvalue())
             except OSError:
                 pass  # persistence is best-effort; memory result valid
         _cache(store)[ckey] = (keys, sidx, rpos, sig)
@@ -162,25 +164,30 @@ def read_rows(store, table: str, shard_id: int, columns: list[str],
                 if dmask is None or not bool(dmask[p])]
         if not live:
             continue
-        path = os.path.join(store.shard_dir(table, shard_id), fname)
-        reader = StripeReader(path)
-        # chunk index per live position; read ONLY those chunks
-        bounds = np.cumsum(np.asarray(reader.footer["chunk_rows"]))
         pos_arr = np.asarray(live, dtype=np.int64)
-        chunk_of = np.searchsorted(bounds, pos_arr, side="right")
-        wanted = set(int(c) for c in chunk_of)
-        starts = np.concatenate([[0], bounds[:-1]])
-        sel = sorted(wanted)
-        # map stripe position → position within the concatenated read
-        offset_of = {}
-        acc = 0
-        for ci in sel:
-            offset_of[ci] = acc - int(starts[ci])
-            acc += int(bounds[ci] - starts[ci])
-        present = [storage_of[c] for c in columns
-                   if storage_of[c] in reader._by_name]
-        fil = _IndexChunkFilter(sel)
-        v, m, _cnt = reader.read(present, fil)
+
+        def read_one(path):
+            reader = StripeReader(path, verify=store._verify_enabled())
+            # chunk index per live position; read ONLY those chunks
+            bounds = np.cumsum(np.asarray(reader.footer["chunk_rows"]))
+            chunk_of = np.searchsorted(bounds, pos_arr, side="right")
+            wanted = set(int(c) for c in chunk_of)
+            starts = np.concatenate([[0], bounds[:-1]])
+            sel = sorted(wanted)
+            # map stripe position → position within the concatenated read
+            offset_of = {}
+            acc = 0
+            for ci in sel:
+                offset_of[ci] = acc - int(starts[ci])
+                acc += int(bounds[ci] - starts[ci])
+            present = [storage_of[c] for c in columns
+                       if storage_of[c] in reader._by_name]
+            fil = _IndexChunkFilter(sel)
+            rv, rm, _cnt = reader.read(present, fil)
+            return rv, rm, chunk_of, offset_of
+
+        v, m, chunk_of, offset_of = store.verified_read(
+            table, shard_id, fname, read_one)
         local = pos_arr + np.asarray(
             [offset_of[int(c)] for c in chunk_of], dtype=np.int64)
         for c in columns:

@@ -19,15 +19,15 @@ Directory layout::
 
 from __future__ import annotations
 
-import json
 import os
 import threading
 
 import numpy as np
 
 from ..catalog import Catalog
-from ..errors import StorageError
-from ..utils.io import atomic_write_json
+from ..errors import CorruptStripe, StorageError
+from ..utils import io as dio
+from . import integrity
 from .dictionary import Dictionary
 from .format import StripeReader, write_stripe
 
@@ -66,9 +66,10 @@ _mwl_mu = threading.Lock()
 class TableStore:
     """Host-side storage manager for all tables under one data directory."""
 
-    def __init__(self, data_dir: str, catalog: Catalog):
+    def __init__(self, data_dir: str, catalog: Catalog, settings=None):
         self.data_dir = data_dir
         self.catalog = catalog
+        self.settings = settings
         self._lock = threading.RLock()
         self._manifests: dict[str, dict] = {}
         self._dicts: dict[tuple[str, str], Dictionary] = {}
@@ -98,8 +99,21 @@ class TableStore:
     def shard_dir(self, table: str, shard_id: int) -> str:
         return os.path.join(self.table_dir(table), f"shard_{shard_id}")
 
+    def replica_dir(self, table: str, shard_id: int,
+                    node_id: int) -> str:
+        """Physical home of a non-primary placement's stripe copies.
+        A flat sibling of the shard dirs (restore points / cleanup
+        treat any table_dir subdirectory as a bag of data files)."""
+        return os.path.join(self.table_dir(table),
+                            f"replica_{node_id}__shard_{shard_id}")
+
     def _manifest_path(self, table: str) -> str:
         return os.path.join(self.table_dir(table), "MANIFEST.json")
+
+    def _verify_enabled(self) -> bool:
+        if self.settings is None:
+            return True
+        return bool(self.settings.get("storage_verify_checksums"))
 
     # -- manifest ----------------------------------------------------------
     def manifest(self, table: str) -> dict:
@@ -107,8 +121,9 @@ class TableStore:
             if table not in self._manifests:
                 path = self._manifest_path(table)
                 if os.path.exists(path):
-                    with open(path) as f:
-                        self._manifests[table] = json.load(f)
+                    # CRC-verified load: a flipped bit in the manifest
+                    # must fail loudly, never route reads at garbage
+                    self._manifests[table] = dio.read_json_checked(path)
                     self._record_manifest_stat(table)
                 else:
                     self._manifests[table] = {"next_stripe": 1, "shards": {}}
@@ -116,8 +131,14 @@ class TableStore:
             return self._manifests[table]
 
     def _save_manifest(self, table: str) -> None:
+        from ..utils.faultinjection import fault_point
+
+        # named seam: a kill here dies BEFORE the visibility flip — the
+        # stripe/mask files exist but stay invisible (clean retry)
+        fault_point("storage.manifest_flip")
         os.makedirs(self.table_dir(table), exist_ok=True)
-        atomic_write_json(self._manifest_path(table), self._manifests[table])
+        dio.atomic_write_json_checked(self._manifest_path(table),
+                                      self._manifests[table])
         with self._lock:
             self._record_manifest_stat(table)
 
@@ -299,6 +320,178 @@ class TableStore:
             self.commit_pending(table, [(shard_id, record)])
         return record
 
+    # -- placement copies (replication-factor ≥ 2 physical replicas) -------
+    def _primary_owner(self, shard_id: int):
+        """Placement whose physical copy is the plain shard dir: the
+        lowest placement_id ever allocated for the shard (stable across
+        quarantine/moves — attribution, not routing)."""
+        ps = self.catalog.all_shard_placements(shard_id)
+        return ps[0] if ps else None
+
+    def _mirror_records(self, table: str,
+                        pending: list[tuple[int, dict]]) -> None:
+        """Copy freshly committed stripe files to every other active
+        placement's replica dir — the physical half of
+        shard_replication_factor (the reference ships the same rows to
+        each placement over COPY; immutable stripes just duplicate the
+        file).  Runs BEFORE the manifest flip: a committed stripe always
+        has its replica copies on disk.
+
+        Hash-distributed tables only: reference/local tables place on
+        EVERY node by construction (8 mirror copies per intermediate-
+        result stripe on an 8-device mesh would tax every recursive-
+        planning materialization), so they keep single-copy
+        shared-storage semantics — corruption there surfaces as a clean
+        CorruptStripe, like factor-1 hash tables."""
+        from ..catalog import DistributionMethod
+
+        meta = self.catalog.tables.get(table)
+        if meta is None or meta.method != DistributionMethod.HASH:
+            return
+        for shard_id, rec in pending:
+            ps = self.catalog.shard_placements(shard_id)
+            if len(ps) < 2:
+                continue
+            owner = self._primary_owner(shard_id)
+            src = os.path.join(self.shard_dir(table, shard_id),
+                               rec["file"])
+            if not os.path.exists(src):
+                continue  # recovery replay after a post-flip crash
+            for p in ps:
+                if owner is not None and \
+                        p.placement_id == owner.placement_id:
+                    continue
+                d = self.replica_dir(table, shard_id, p.node_id)
+                dst = os.path.join(d, rec["file"])
+                if os.path.exists(dst):
+                    continue  # idempotent replay
+                os.makedirs(d, exist_ok=True)
+                dio.copy_file_durable(src, dst)
+
+    def _copy_paths(self, table: str, shard_id: int,
+                    fname: str) -> list[str]:
+        """Every on-disk copy of one stripe file, primary first."""
+        out = [os.path.join(self.shard_dir(table, shard_id), fname)]
+        tdir = self.table_dir(table)
+        suffix = f"__shard_{shard_id}"
+        try:
+            entries = sorted(os.listdir(tdir))
+        except OSError:
+            return out
+        for e in entries:
+            if e.startswith("replica_") and e.endswith(suffix):
+                p = os.path.join(tdir, e, fname)
+                if os.path.exists(p):
+                    out.append(p)
+        return out
+
+    def stripe_read_path(self, table: str, shard_id: int,
+                         fname: str) -> str:
+        """Physical path the CURRENT routing placement reads: primary
+        copy for the owner placement, the replica-dir copy otherwise
+        (falling back to primary when no mirror was ever written —
+        shared-storage semantics).  Suspect placements re-route here:
+        marking the primary's placement suspect makes the next read
+        resolve to a surviving replica copy."""
+        primary = os.path.join(self.shard_dir(table, shard_id), fname)
+        try:
+            p = self.catalog.active_placement(shard_id, probe=False)
+        except Exception:
+            return primary
+        owner = self._primary_owner(shard_id)
+        if owner is None or p.placement_id == owner.placement_id:
+            return primary
+        alt = os.path.join(self.replica_dir(table, shard_id, p.node_id),
+                           fname)
+        return alt if os.path.exists(alt) else primary
+
+    def _placement_of_copy(self, shard_id: int, path: str):
+        """The placement whose physical copy `path` is (suspect-marking
+        attribution for corrupt copies)."""
+        base = os.path.basename(os.path.dirname(path))
+        if base.startswith("replica_"):
+            node_id = int(base[len("replica_"):].split("__", 1)[0])
+            for p in self.catalog.all_shard_placements(shard_id):
+                if p.node_id == node_id:
+                    return p
+            return None
+        return self._primary_owner(shard_id)
+
+    def _maybe_bitflip(self, path: str) -> None:
+        """`storage.stripe_bitflip` seam: an armed injection corrupts
+        one byte of the file about to be read and lets the read proceed
+        — silent bit rot the CRC path must catch (detect + repair or
+        clean CorruptStripe, never wrong rows)."""
+        from ..utils.faultinjection import InjectedFault, fault_point
+
+        try:
+            fault_point("storage.stripe_bitflip")
+        except InjectedFault:
+            try:
+                integrity.flip_one_bit(path)
+            except (OSError, CorruptStripe):
+                pass  # file too small/unwritable: nothing to corrupt
+
+    def verified_read(self, table: str, shard_id: int, fname: str,
+                      reader_fn):
+        """Run `reader_fn(path)` against the routing placement's copy
+        with end-to-end corruption handling: a CorruptStripe from one
+        copy marks its placement suspect (the PR-3 placement-failure
+        re-route), the read transparently answers from another copy
+        that fully verifies, and the damaged copy is healed in place
+        from the verified bytes (best-effort — a failed heal leaves the
+        placement suspect for the scrubber).  Only when EVERY copy is
+        damaged does CorruptStripe propagate — a clean error, never
+        wrong rows.  In-place healing matters beyond latency: without
+        it a corrupt copy lingers until the next scrub, and a second
+        bit flip on the surviving copy in that window is permanent data
+        loss (replication factor 2 tolerates ONE dead copy at a time).
+        """
+        path = self.stripe_read_path(table, shard_id, fname)
+        self._maybe_bitflip(path)
+        verify = self._verify_enabled()
+        try:
+            result = reader_fn(path)
+            if verify:
+                integrity.note("stripes_verified")
+            return result
+        except CorruptStripe as first:
+            integrity.note("corruption_detected")
+            bad = self._placement_of_copy(shard_id, path)
+            if bad is not None:
+                self.catalog.mark_placement_suspect(bad.placement_id)
+            for alt in self._copy_paths(table, shard_id, fname):
+                if alt == path:
+                    continue
+                try:
+                    integrity.verify_stripe_file(alt)
+                    result = reader_fn(alt)
+                except CorruptStripe:
+                    integrity.note("corruption_detected")
+                    p = self._placement_of_copy(shard_id, alt)
+                    if p is not None:
+                        self.catalog.mark_placement_suspect(
+                            p.placement_id)
+                    continue
+                integrity.note("read_repairs")
+                self._heal_copy(path, alt, bad)
+                return result
+            raise first
+
+    def _heal_copy(self, dst: str, src: str, bad_placement) -> None:
+        """Rewrite a corrupt copy from verified bytes at read time; on
+        success the placement is trusted again.  Failures leave it
+        suspect — the scrubber's quarantine + re-replication pass is
+        the heavier fallback for corruption found at rest."""
+        try:
+            dio.copy_file_durable(src, dst)
+            integrity.verify_stripe_file(dst)
+        except (OSError, CorruptStripe):
+            return
+        if bad_placement is not None:
+            self.catalog.clear_placement_suspect(
+                bad_placement.placement_id)
+
     def commit_pending(self, table: str,
                        pending: list[tuple[int, dict]]) -> None:
         """Atomically make a batch of stripes visible: one manifest write.
@@ -306,6 +499,12 @@ class TableStore:
         Dictionaries are persisted first so a committed STRING stripe can
         never reference codes missing from the on-disk dictionary (the
         dictionary is append-only, so over-persisting is harmless)."""
+        # replica copies touch only immutable, uniquely-named stripe
+        # files plus the catalog — made before the locks so mirroring a
+        # large stripe cannot stall every other table's readers, yet
+        # still BEFORE the manifest flip: a committed stripe always has
+        # its replica copies on disk
+        self._mirror_records(table, pending)
         with self._write_lock(table), self._lock:
             self.save_dictionaries(table)
             man = self._reload_manifest_locked(table)
@@ -336,8 +535,8 @@ class TableStore:
         fname = record.get("deletes")
         if not fname:
             return None
-        with open(self._delete_mask_path(table, shard_id, fname), "rb") as f:
-            return np.load(f)
+        return integrity.read_mask(
+            self._delete_mask_path(table, shard_id, fname))
 
     # -- transaction overlay ----------------------------------------------
     def _overlay_records(self, table: str, shard_id: int) -> list[dict]:
@@ -372,6 +571,9 @@ class TableStore:
 
         fault_point("store.apply_dml")
         events: list[dict] = []
+        # before the locks, like commit_pending: immutable-file copies
+        # must not serialize against the store-wide lock
+        self._mirror_records(table, list(pending))
         with self._write_lock(table), self._lock:
             self.save_dictionaries(table)
             man = self._reload_manifest_locked(table)
@@ -407,12 +609,7 @@ class TableStore:
                     version = rec.get("del_version", 0) + 1
                     delname = f"{fname}.del{version:04d}.npy"
                     path = self._delete_mask_path(table, shard_id, delname)
-                    tmp = path + ".tmp"
-                    with open(tmp, "wb") as f:
-                        np.save(f, combined)
-                        f.flush()
-                        os.fsync(f.fileno())
-                    os.replace(tmp, path)
+                    integrity.write_mask(path, combined)
                     if rec.get("deletes"):
                         stale.append(self._delete_mask_path(
                             table, shard_id, rec["deletes"]))
@@ -455,8 +652,10 @@ class TableStore:
             record = next(r for r in self.shard_stripe_records(table,
                                                                shard_id)
                           if r["file"] == fname)
-        path = os.path.join(self.shard_dir(table, shard_id), fname)
-        vals, mask, n = StripeReader(path).read(columns)
+        verify = self._verify_enabled()
+        vals, mask, n = self.verified_read(
+            table, shard_id, fname,
+            lambda p: StripeReader(p, verify=verify).read(columns))
         return vals, mask, n, self.effective_delete_mask(table, shard_id,
                                                          record)
 
@@ -572,26 +771,34 @@ class TableStore:
         man = self.manifest(table)
         records = (list(man["shards"].get(str(shard_id), []))
                    + self._overlay_records(table, shard_id))
+        verify = self._verify_enabled()
         for rec in records:
-            p = os.path.join(self.shard_dir(table, shard_id), rec["file"])
             dmask = self.effective_delete_mask(table, shard_id, rec)
-            # a stripe with deletions reads whole (positions must align with
-            # the bitmap), trading its chunk skipping for correctness
-            reader = StripeReader(p)
-            # columns added by ALTER TABLE after this stripe was written
-            # read as all-NULL (schema evolution is manifest-level; old
-            # stripes are immutable)
-            present = [storage_of[c] for c in columns
-                       if storage_of[c] in reader._by_name]
-            missing = [c for c in columns
-                       if storage_of[c] not in reader._by_name]
-            if present or not missing:
-                v, m, n = reader.read(
-                    present, None if dmask is not None else chunk_filter)
-                v = {requested_of[s]: a for s, a in v.items()}
-                m = {requested_of[s]: a for s, a in m.items()}
-            else:  # projection of only post-ALTER columns
-                v, m, n = {}, {}, reader.row_count
+
+            def read_one(path):
+                # a stripe with deletions reads whole (positions must
+                # align with the bitmap), trading its chunk skipping
+                # for correctness
+                reader = StripeReader(path, verify=verify)
+                # columns added by ALTER TABLE after this stripe was
+                # written read as all-NULL (schema evolution is
+                # manifest-level; old stripes are immutable)
+                present = [storage_of[c] for c in columns
+                           if storage_of[c] in reader._by_name]
+                absent = [c for c in columns
+                          if storage_of[c] not in reader._by_name]
+                if present or not absent:
+                    rv, rm, rn = reader.read(
+                        present,
+                        None if dmask is not None else chunk_filter)
+                    rv = {requested_of[s]: a for s, a in rv.items()}
+                    rm = {requested_of[s]: a for s, a in rm.items()}
+                else:  # projection of only post-ALTER columns
+                    rv, rm, rn = {}, {}, reader.row_count
+                return rv, rm, rn, absent
+
+            v, m, n, missing = self.verified_read(table, shard_id,
+                                                  rec["file"], read_one)
             for c in missing:
                 dt = meta.schema.column(c).dtype.numpy_dtype
                 v[c] = np.zeros(n, dtype=dt)

@@ -34,6 +34,7 @@ import threading
 import numpy as np
 
 from ..errors import ExecutionError
+from ..storage import integrity
 from .clock import global_clock
 
 
@@ -138,8 +139,13 @@ class TransactionManager:
     def _commit_staged_locked(self, txn: Transaction) -> None:
         from ..utils.faultinjection import fault_point
 
+        from ..utils import io as dio
+
         tdir = self._txn_dir(txn.txid)
         os.makedirs(tdir, exist_ok=True)
+        # make the txn directory's existence itself durable before any
+        # record inside it claims to be
+        dio.fsync_dir(self.log_dir)
         fault_point("txn.prepare")
         # 1. PREPARE: persist staged masks + the effect list
         effects: dict[str, dict] = {}
@@ -152,32 +158,22 @@ class TransactionManager:
         for (table, shard_id, fname), mask in txn.overlay.deletes.items():
             mask_file = f"mask_{mask_no:04d}.npy"
             mask_no += 1
-            with open(os.path.join(tdir, mask_file), "wb") as f:
-                np.save(f, mask)
-                f.flush()
-                os.fsync(f.fileno())
+            # staged masks get the same CRC framing as committed ones:
+            # recovery replays them into live manifests, so a rotted
+            # staged mask is as dangerous as a rotted committed one
+            integrity.write_mask(os.path.join(tdir, mask_file), mask)
             effects[table]["deletes"].append([shard_id, fname, mask_file])
-        prepare_path = os.path.join(tdir, "prepare.json")
-        tmp = prepare_path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump({"txid": txn.txid, "effects": effects}, f)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, prepare_path)
-        _fsync_dir(tdir)
+        dio.atomic_write_json(os.path.join(tdir, "prepare.json"),
+                              {"txid": txn.txid, "effects": effects},
+                              indent=None)
         fault_point("txn.commit_record")  # prepared but no commit record
-        # 2. commit record — the atomic commit point.  The directory fsyncs
-        # make the renames themselves durable (the WAL-durability the
-        # reference gets from the pg_dist_transaction INSERT): without
-        # them a crash could lose the commit record and recovery would
-        # roll back a committed transaction.
-        commit_path = os.path.join(tdir, "commit")
-        with open(commit_path + ".tmp", "w") as f:
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(commit_path + ".tmp", commit_path)
-        _fsync_dir(tdir)
-        _fsync_dir(self.log_dir)
+        # 2. commit record — the atomic commit point.  The tmp+rename+
+        # dir-fsync discipline inside atomic_write_bytes makes the
+        # record itself durable (the WAL-durability the reference gets
+        # from the pg_dist_transaction INSERT): without it a crash could
+        # lose the commit record and recovery would roll back a
+        # committed transaction.
+        dio.atomic_write_bytes(os.path.join(tdir, "commit"), b"")
         fault_point("txn.apply")  # commit record durable, not yet applied
         # 3. apply per table (each manifest flip is atomic; replay-safe)
         _apply_effects(self.store, tdir, effects)
@@ -196,20 +192,13 @@ class TransactionManager:
         return os.path.exists(os.path.join(self._txn_dir(txid), "commit"))
 
 
-def _fsync_dir(path: str) -> None:
-    fd = os.open(path, os.O_RDONLY)
-    try:
-        os.fsync(fd)
-    finally:
-        os.close(fd)
-
-
 def _apply_effects(store, tdir: str, effects: dict) -> None:
     for table, eff in effects.items():
         deletes: dict[int, dict[str, np.ndarray]] = {}
         for shard_id, fname, mask_file in eff["deletes"]:
-            with open(os.path.join(tdir, mask_file), "rb") as f:
-                mask = np.load(f)
+            # CRC-verified load: failing a roll-forward loudly beats
+            # applying a silently rotted mask (wrong rows forever)
+            mask = integrity.read_mask(os.path.join(tdir, mask_file))
             deletes.setdefault(int(shard_id), {})[fname] = mask
         pending = [(int(s), r) for s, r in eff["pending"]]
         if deletes or pending:

@@ -61,6 +61,14 @@ FAULT_POINTS: dict[str, str] = {
     "store.append_stripe": "storage/table_store.py — shard stripe write",
     "store.apply_dml": "storage/table_store.py — DML manifest flip",
     "store.read_shard": "storage/table_store.py — shard stripe read",
+    "storage.stripe_torn_write":
+        "storage/format.py — stripe finalize (kill leaves a torn tmp)",
+    "storage.stripe_bitflip":
+        "storage/table_store.py — silent bit rot injected before a read",
+    "storage.manifest_flip":
+        "storage/table_store.py — manifest visibility flip",
+    "operations.shard_split":
+        "operations/shard_split.py — children written, catalog not yet",
     "executor.overflow_retry": "executor/runner.py — capacity regrow",
     "executor.plan_cache_fill": "executor/runner.py — compiled-plan insert",
     "executor.agg_bucket_fill":

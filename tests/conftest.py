@@ -28,7 +28,8 @@ import pytest  # noqa: E402
 # the front; everything else keeps its relative order (sort is
 # stable).  tools/t1_times.py reports per-file costs and where the
 # budget cutoff lands.
-_TIER1_FIRST = ("test_lint.py", "test_tools.py", "test_wlm.py")
+_TIER1_FIRST = ("test_lint.py", "test_tools.py", "test_wlm.py",
+                "test_integrity.py", "test_crash_torture.py")
 
 
 def pytest_collection_modifyitems(config, items):

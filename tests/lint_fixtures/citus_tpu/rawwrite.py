@@ -1,0 +1,30 @@
+"""raw-durable-write fixtures: durable writes bypassing utils/io."""
+
+import os
+
+
+def bad_replace(tmp, path):
+    os.replace(tmp, path)
+
+
+def bad_fsync(f):
+    os.fsync(f.fileno())
+
+
+def bad_open(path):
+    with open(path, "w") as f:
+        f.write("x")
+
+
+def fine_read(path):
+    with open(path) as f:  # read mode: not a durable write
+        return f.read()
+
+
+def fine_binary_read(path):
+    with open(path, mode="rb") as f:
+        return f.read(1)
+
+
+def fine_ignored(tmp, path):
+    os.replace(tmp, path)  # graftlint: ignore[raw-durable-write] — fixture: sanctioned site

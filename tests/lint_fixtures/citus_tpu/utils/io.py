@@ -1,0 +1,12 @@
+"""Clean fixture: the io seam itself may use the raw primitives."""
+
+import os
+
+
+def atomic_write_bytes(path, payload):
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(payload)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)

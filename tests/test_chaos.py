@@ -61,6 +61,14 @@ FAULT_POOL = [
     dict(name="store.read_shard", p=0.5, times=2),
     dict(name="wlm.admit"),
     dict(name="wlm.admit", p=0.5, times=2),
+    # durable-state seams (PR 7): a kill before the stripe finalize /
+    # manifest flip must stay invisible; a silent bitflip must be
+    # caught by the CRC path and read-repaired from the factor-2
+    # replica copy (never wrong rows — the soak oracle would see them)
+    dict(name="storage.stripe_torn_write"),
+    dict(name="storage.manifest_flip"),
+    dict(name="storage.stripe_bitflip"),
+    dict(name="storage.stripe_bitflip", p=0.5, times=2),
 ]
 
 

@@ -1,0 +1,388 @@
+"""End-to-end storage integrity: CRC detection, transparent read
+repair from replica copies, scrubber quarantine + re-replication,
+restore-point validation, and the observability surface.
+
+Acceptance (ISSUE 7): a bit-flipped stripe under
+shard_replication_factor=2 is transparently read-repaired (correct
+rows, read_repairs_total increments, the corrupt placement is
+quarantined and re-replicated by the scrubber); under factor 1 the
+same query fails with a clean CorruptStripe — never wrong rows.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import citus_tpu
+from citus_tpu.catalog import Catalog
+from citus_tpu.errors import CorruptStripe, StorageError
+from citus_tpu.storage import StripeReader, TableStore, write_stripe
+from citus_tpu.storage import integrity
+from citus_tpu.types import ColumnDef, DataType, TableSchema
+from citus_tpu.utils import faultinjection as fi
+from citus_tpu.utils import io as dio
+
+SCHEMA_COLS = [("k", DataType.INT64), ("v", DataType.FLOAT64)]
+
+
+def make_cols(n, rng):
+    return {"k": rng.integers(0, 1 << 20, size=n).astype(np.int64),
+            "v": rng.normal(size=n)}
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    fi.reset()
+    yield
+    fi.reset()
+
+
+# ---------------------------------------------------------------------------
+# format-level CRC behavior
+# ---------------------------------------------------------------------------
+class TestStripeCrc:
+    def test_v2_footer_and_chunk_crcs_written(self, tmp_path, rng):
+        path = str(tmp_path / "s.ctps")
+        footer = write_stripe(path, SCHEMA_COLS, make_cols(1000, rng))
+        ch = footer["columns"][0]["chunks"][0]
+        assert isinstance(ch["crc"], int)
+        StripeReader(path).verify_all_chunks()  # round-trips clean
+
+    def test_bitflip_detected_on_read(self, tmp_path, rng):
+        path = str(tmp_path / "s.ctps")
+        write_stripe(path, SCHEMA_COLS, make_cols(5000, rng),
+                     codec="zlib")
+        integrity.flip_one_bit(path)
+        with pytest.raises(CorruptStripe):
+            r = StripeReader(path)
+            r.read()
+            r.verify_all_chunks()  # flip may land footer-side or data-side
+
+    def test_verify_flag_off_skips_crc(self, tmp_path, rng):
+        # structural checks still run; chunk CRCs don't — measurement
+        # lever for the PERF_NOTES scan-overhead A/B
+        path = str(tmp_path / "s.ctps")
+        cols = make_cols(1000, rng)
+        write_stripe(path, SCHEMA_COLS, cols, codec="none")
+        # flip a byte INSIDE a value buffer of the uncompressed stripe
+        with open(path, "r+b") as f:
+            f.seek(16)
+            b = f.read(1)
+            f.seek(16)
+            f.write(bytes([b[0] ^ 0x01]))
+        with pytest.raises(CorruptStripe):
+            StripeReader(path, verify=True).read()
+        vals, _, n = StripeReader(path, verify=False).read()
+        assert n == 1000  # unverified read returns (wrong) bytes
+
+    def test_truncated_stripe_is_corrupt_stripe(self, tmp_path, rng):
+        path = str(tmp_path / "s.ctps")
+        write_stripe(path, SCHEMA_COLS, make_cols(1000, rng))
+        size = os.path.getsize(path)
+        with open(path, "r+b") as f:
+            f.truncate(size // 2)
+        with pytest.raises(CorruptStripe):
+            StripeReader(path)
+
+    def test_corrupt_stripe_is_storage_error(self):
+        assert issubclass(CorruptStripe, StorageError)
+
+
+class TestCheckedJson:
+    def test_roundtrip_and_detection(self, tmp_path):
+        p = str(tmp_path / "m.json")
+        dio.atomic_write_json_checked(p, {"a": 1, "b": [2, 3]})
+        assert dio.read_json_checked(p) == {"a": 1, "b": [2, 3]}
+        raw = open(p).read().replace('"a": 1', '"a": 7')
+        with open(p, "w") as f:
+            f.write(raw)
+        with pytest.raises(CorruptStripe, match="checksum"):
+            dio.read_json_checked(p)
+
+    def test_legacy_file_without_crc_loads(self, tmp_path):
+        p = str(tmp_path / "m.json")
+        dio.atomic_write_json(p, {"a": 1})
+        assert dio.read_json_checked(p) == {"a": 1}
+
+
+# ---------------------------------------------------------------------------
+# store-level read repair
+# ---------------------------------------------------------------------------
+def _store_with_replicas(tmp_path, rng, factor=2):
+    cat = Catalog()
+    cat.add_node("tpu:0")
+    cat.add_node("tpu:1")
+    schema = TableSchema(tuple(ColumnDef(n, t) for n, t in SCHEMA_COLS))
+    cat.create_distributed_table("t", schema, "k", 2,
+                                 replication_factor=factor)
+    store = TableStore(str(tmp_path / "data"), cat)
+    sid = cat.table_shards("t")[0].shard_id
+    cols = make_cols(3000, rng)
+    store.append_stripe("t", sid, cols)
+    return cat, store, sid, cols
+
+
+class TestReadRepair:
+    def test_mirror_written_under_factor_2(self, tmp_path, rng):
+        cat, store, sid, _ = _store_with_replicas(tmp_path, rng)
+        ps = cat.shard_placements(sid)
+        assert len(ps) == 2
+        mirror = store.replica_dir("t", sid, ps[1].node_id)
+        assert os.path.isdir(mirror) and len(os.listdir(mirror)) == 1
+
+    def test_factor2_bitflip_transparent_repair(self, tmp_path, rng):
+        cat, store, sid, cols = _store_with_replicas(tmp_path, rng)
+        rec = store.manifest("t")["shards"][str(sid)][0]
+        primary = os.path.join(store.shard_dir("t", sid), rec["file"])
+        integrity.flip_one_bit(primary)
+        base = integrity.snapshot()
+        vals, _, n = store.read_shard("t", sid)  # all columns: the flip
+        # may land in either column's buffers (or the footer)
+        assert n == 3000  # correct rows, not an error
+        d = integrity.delta(base)
+        assert d["corruption_detected"] >= 1
+        assert d["read_repairs"] >= 1
+        # the read also healed the corrupt copy in place from the
+        # verified mirror, so the placement is trusted again (a corrupt
+        # copy left in place until the next scrub + a second flip on
+        # the survivor would be permanent data loss)
+        integrity.verify_stripe_file(primary)
+        owner = store._primary_owner(sid)
+        assert owner.placement_id not in cat._suspect_placements
+
+    def test_factor1_bitflip_clean_corrupt_stripe(self, tmp_path, rng):
+        cat, store, sid, _ = _store_with_replicas(tmp_path, rng,
+                                                  factor=1)
+        rec = store.manifest("t")["shards"][str(sid)][0]
+        primary = os.path.join(store.shard_dir("t", sid), rec["file"])
+        integrity.flip_one_bit(primary)
+        with pytest.raises(CorruptStripe):
+            store.read_shard("t", sid)  # all columns: catch the flip
+            # wherever it landed
+
+    def test_scrubber_quarantines_and_rereplicates(self, tmp_path, rng):
+        from citus_tpu.operations.scrubber import ScrubReport, scrub_store
+
+        cat, store, sid, _ = _store_with_replicas(tmp_path, rng)
+        rec = store.manifest("t")["shards"][str(sid)][0]
+        primary = os.path.join(store.shard_dir("t", sid), rec["file"])
+        integrity.flip_one_bit(primary)
+        rep = scrub_store(cat, store, ScrubReport())
+        assert rep.corrupt_copies == 1
+        assert rep.quarantined == 1
+        assert rep.repaired == 1
+        assert rep.unrepairable == 0
+        # repaired in place from the verified mirror: primary verifies
+        integrity.verify_stripe_file(primary)
+        # placement restored to active + unsuspected
+        owner = store._primary_owner(sid)
+        assert owner.shard_state == "active"
+        assert owner.placement_id not in cat._suspect_placements
+        # second pass is clean
+        rep2 = scrub_store(cat, store, ScrubReport())
+        assert rep2.corrupt_copies == 0 and rep2.repaired == 0
+
+    def test_scrubber_factor1_reports_unrepairable(self, tmp_path, rng):
+        from citus_tpu.operations.scrubber import ScrubReport, scrub_store
+
+        cat, store, sid, _ = _store_with_replicas(tmp_path, rng,
+                                                  factor=1)
+        rec = store.manifest("t")["shards"][str(sid)][0]
+        integrity.flip_one_bit(
+            os.path.join(store.shard_dir("t", sid), rec["file"]))
+        rep = scrub_store(cat, store, ScrubReport())
+        assert rep.corrupt_copies == 1
+        assert rep.unrepairable == 1 and rep.repaired == 0
+        assert rep.quarantined == 0  # last copy stays routable
+
+
+# ---------------------------------------------------------------------------
+# session-level acceptance + observability
+# ---------------------------------------------------------------------------
+class TestSessionIntegration:
+    def test_end_to_end_repair_counters_quarantine(self, tmp_path):
+        from citus_tpu.stats import counters as sc
+
+        sess = citus_tpu.connect(data_dir=str(tmp_path / "d"),
+                                 n_devices=2,
+                                 shard_replication_factor=2,
+                                 retry_backoff_base_ms=1)
+        sess.execute("CREATE TABLE kv (id INT, v INT)")
+        sess.execute("SELECT create_distributed_table('kv', 'id', 2)")
+        sess.execute("INSERT INTO kv VALUES " + ", ".join(
+            f"({i}, {i * 10})" for i in range(64)))
+        # flip a bit in one committed primary stripe
+        man = sess.store.manifest("kv")
+        sid = next(s for s in man["shards"] if man["shards"][s])
+        rec = man["shards"][sid][0]
+        primary = os.path.join(sess.store.shard_dir("kv", int(sid)),
+                               rec["file"])
+        integrity.flip_one_bit(primary)
+        sess.store.refresh("kv")  # drop any warm feed/manifest cache
+        got = {int(i): int(v) for i, v in
+               sess.execute("SELECT id, v FROM kv").rows()}
+        assert got == {i: i * 10 for i in range(64)}  # correct rows
+        snap = sess.stats.counters.snapshot()
+        assert snap[sc.READ_REPAIRS_TOTAL] >= 1
+        assert snap[sc.CORRUPTION_DETECTED_TOTAL] >= 1
+        integrity.verify_stripe_file(primary)  # healed in place too
+        # corruption found AT REST (no read touched it): the scrubber
+        # (citus_check_cluster UDF → background job) quarantines the
+        # placement and re-replicates from the verified mirror
+        integrity.flip_one_bit(primary)
+        row = sess.execute("SELECT citus_check_cluster(0)").rows()[0]
+        cols = dict(zip(
+            ["stripes_verified", "masks_verified", "corrupt_copies",
+             "quarantined", "repaired", "unrepairable",
+             "temps_removed", "replica_dirs_removed"], row))
+        assert cols["corrupt_copies"] >= 1
+        assert cols["repaired"] >= 1
+        integrity.verify_stripe_file(primary)
+        snap = sess.stats.counters.snapshot()
+        assert snap[sc.SCRUB_RUNS_TOTAL] == 1
+        assert snap[sc.SCRUB_REPAIRS_TOTAL] >= 1
+        # post-repair scrub is clean
+        row2 = sess.execute("SELECT citus_check_cluster(0)").rows()[0]
+        assert int(row2[2]) == 0  # corrupt_copies
+        sess.close()
+
+    def test_factor1_query_fails_cleanly(self, tmp_path):
+        sess = citus_tpu.connect(data_dir=str(tmp_path / "d"),
+                                 n_devices=2, retry_backoff_base_ms=1)
+        sess.execute("CREATE TABLE kv (id INT, v INT)")
+        sess.execute("SELECT create_distributed_table('kv', 'id', 2)")
+        sess.execute("INSERT INTO kv VALUES " + ", ".join(
+            f"({i}, {i})" for i in range(64)))
+        man = sess.store.manifest("kv")
+        sid = next(s for s in man["shards"] if man["shards"][s])
+        rec = man["shards"][sid][0]
+        integrity.flip_one_bit(os.path.join(
+            sess.store.shard_dir("kv", int(sid)), rec["file"]))
+        sess.store.refresh("kv")
+        with pytest.raises(CorruptStripe):
+            sess.execute("SELECT sum(v) FROM kv")
+        sess.close()
+
+    def test_explain_analyze_integrity_line(self, tmp_path):
+        sess = citus_tpu.connect(data_dir=str(tmp_path / "d"),
+                                 n_devices=2)
+        sess.execute("CREATE TABLE kv (id INT, v INT)")
+        sess.execute("SELECT create_distributed_table('kv', 'id', 2)")
+        sess.execute("INSERT INTO kv VALUES (1, 1), (2, 2)")
+        plan = "\n".join(r[0] for r in sess.execute(
+            "EXPLAIN ANALYZE SELECT sum(v) FROM kv").rows())
+        assert "Integrity:" in plan
+        assert "stripes verified=" in plan
+        sess.close()
+
+    def test_stat_activity_has_read_repairs_column(self, tmp_path):
+        sess = citus_tpu.connect(data_dir=str(tmp_path / "d"),
+                                 n_devices=2)
+        r = sess.execute("SELECT citus_stat_activity()")
+        assert "read_repairs" in r.column_names
+        sess.close()
+
+
+# ---------------------------------------------------------------------------
+# restore-point validation (satellite: no wipe before verify)
+# ---------------------------------------------------------------------------
+class TestRestorePointValidation:
+    def test_damaged_snapshot_refuses_and_preserves_live(self, tmp_path):
+        from citus_tpu.operations.restore_point import restore_cluster
+
+        d = str(tmp_path / "d")
+        sess = citus_tpu.connect(data_dir=d, n_devices=2)
+        sess.execute("CREATE TABLE kv (id INT, v INT)")
+        sess.execute("SELECT create_distributed_table('kv', 'id', 2)")
+        sess.execute("INSERT INTO kv VALUES (1, 10), (2, 20)")
+        sess.execute("SELECT citus_create_restore_point('rp1')")
+        sess.execute("INSERT INTO kv VALUES (3, 30)")
+        sess.close()
+        # damage the snapshot: flip a bit in a snapshotted stripe.
+        # Hardlinked snapshots share bytes with live files, so corrupt
+        # a COPY-free way: find the snapshot stripe and rewrite it torn
+        snap_tables = os.path.join(d, "restore_points", "rp1", "tables",
+                                   "kv")
+        stripe = None
+        for dp, _dirs, files in os.walk(snap_tables):
+            for f in files:
+                if f.endswith(".ctps"):
+                    stripe = os.path.join(dp, f)
+                    break
+        payload = open(stripe, "rb").read()
+        os.unlink(stripe)  # break the hardlink before corrupting
+        with open(stripe, "wb") as f:
+            f.write(payload[: len(payload) // 2])
+        with pytest.raises(CorruptStripe):
+            restore_cluster(d, "rp1")
+        # live data untouched: all three rows still readable
+        sess2 = citus_tpu.connect(data_dir=d, n_devices=2)
+        got = {int(i): int(v) for i, v in
+               sess2.execute("SELECT id, v FROM kv").rows()}
+        assert got == {1: 10, 2: 20, 3: 30}
+        sess2.close()
+
+    def test_intact_snapshot_still_restores(self, tmp_path):
+        from citus_tpu.operations.restore_point import restore_cluster
+
+        d = str(tmp_path / "d")
+        sess = citus_tpu.connect(data_dir=d, n_devices=2)
+        sess.execute("CREATE TABLE kv (id INT, v INT)")
+        sess.execute("SELECT create_distributed_table('kv', 'id', 2)")
+        sess.execute("INSERT INTO kv VALUES (1, 10)")
+        sess.execute("SELECT citus_create_restore_point('rp1')")
+        sess.execute("INSERT INTO kv VALUES (2, 20)")
+        sess.close()
+        restore_cluster(d, "rp1")
+        sess2 = citus_tpu.connect(data_dir=d, n_devices=2)
+        got = {int(i): int(v) for i, v in
+               sess2.execute("SELECT id, v FROM kv").rows()}
+        assert got == {1: 10}
+        sess2.close()
+
+
+# ---------------------------------------------------------------------------
+# directed fault-point tests (registry: every point armed by >=1 test)
+# ---------------------------------------------------------------------------
+class TestStorageFaultPoints:
+    def _sess(self, tmp_path, **kw):
+        kw.setdefault("retry_backoff_base_ms", 1)
+        kw.setdefault("n_devices", 2)
+        return citus_tpu.connect(data_dir=str(tmp_path / "d"), **kw)
+
+    def test_stripe_torn_write_retries_clean(self, tmp_path):
+        sess = self._sess(tmp_path, max_statement_retries=2)
+        sess.execute("CREATE TABLE kv (id INT, v INT)")
+        sess.execute("SELECT create_distributed_table('kv', 'id', 2)")
+        with fi.inject("storage.stripe_torn_write"):
+            sess.execute("INSERT INTO kv VALUES (1, 1)")  # retried
+        assert int(sess.execute(
+            "SELECT count(*) FROM kv").rows()[0][0]) == 1
+        sess.close()
+
+    def test_manifest_flip_fault_keeps_write_invisible(self, tmp_path):
+        sess = self._sess(tmp_path, max_statement_retries=0)
+        sess.execute("CREATE TABLE kv (id INT, v INT)")
+        sess.execute("SELECT create_distributed_table('kv', 'id', 2)")
+        sess.execute("INSERT INTO kv VALUES (1, 1)")
+        with fi.inject("storage.manifest_flip"):
+            with pytest.raises(Exception):
+                sess.execute("INSERT INTO kv VALUES (2, 2)")
+        assert int(sess.execute(
+            "SELECT count(*) FROM kv").rows()[0][0]) == 1
+        sess.close()
+
+    def test_stripe_bitflip_fault_detected(self, tmp_path):
+        sess = self._sess(tmp_path, max_statement_retries=2,
+                          shard_replication_factor=2)
+        sess.execute("CREATE TABLE kv (id INT, v INT)")
+        sess.execute("SELECT create_distributed_table('kv', 'id', 2)")
+        sess.execute("INSERT INTO kv VALUES " + ", ".join(
+            f"({i}, {i})" for i in range(32)))
+        sess.store.refresh("kv")
+        with fi.inject("storage.stripe_bitflip"):
+            got = {int(i) for i, in
+                   sess.execute("SELECT id FROM kv").rows()}
+        assert got == set(range(32))  # repaired or untouched, never wrong
+        sess.close()
