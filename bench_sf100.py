@@ -32,7 +32,7 @@ def main():
     if data_dir is None:
         data_dir = tempfile.mkdtemp(prefix="citus_tpu_sf100_")
     print(f"data dir: {data_dir}", flush=True)
-    sess = Session(data_dir=data_dir)
+    sess = Session(data_dir=data_dir, serving_result_cache_bytes=0)
     if fresh:
         t0 = time.perf_counter()
 

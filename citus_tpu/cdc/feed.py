@@ -240,6 +240,72 @@ class ChangeLog:
         return self._next_lsn - 1
 
 
+class ChangeFeedCursor:
+    """Incremental, offset-tracking journal consumer — the invalidation
+    subscription seam.
+
+    Polling consumers (the serving result cache invalidates per table on
+    every statement) cannot afford ``ChangeLog.read``'s full-file scan;
+    this cursor remembers its byte offset and the unchanged-size fast
+    path is ONE ``os.path.getsize`` call.  Starts at the journal's
+    CURRENT tail: a new subscriber cares about changes after it attached
+    (catch-up reads ride ``ChangeLog.read`` with an lsn).
+
+    ``poll()`` returns the new complete events, or ``None`` when the
+    journal REGRESSED (restore_cluster replaced it with a snapshot —
+    nothing previously proven fresh still is; the cursor repositions to
+    the new tail).  A torn trailing line (crash mid-append) is left
+    unconsumed until a later append terminates it; emit()'s tail
+    isolation guarantees it eventually parses or is skipped."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._offset = self._size()
+        self.last_lsn = 0
+        self.torn_lines = 0
+
+    def _size(self) -> int:
+        try:
+            return os.path.getsize(self.path)
+        except OSError:
+            return 0
+
+    def poll(self) -> list[dict] | None:
+        size = self._size()
+        if size == self._offset:
+            return []
+        if size < self._offset:
+            self._offset = size  # journal replaced: resubscribe at tail
+            return None
+        try:
+            with open(self.path, "rb") as f:
+                f.seek(self._offset)
+                block = f.read(size - self._offset)
+        except OSError:
+            return []
+        # consume only up to the last newline: a partial trailing line
+        # is a write in flight (or a torn crash tail) — leave it for the
+        # poll that sees its terminator
+        end = block.rfind(b"\n")
+        if end < 0:
+            return []
+        consumed = block[:end + 1]
+        self._offset += end + 1
+        events: list[dict] = []
+        for line in consumed.splitlines():
+            if not line.strip():
+                continue
+            try:
+                ev = json.loads(line)
+                ev_lsn = int(ev["lsn"])
+            except (ValueError, KeyError):
+                self.torn_lines += 1  # isolated torn line mid-journal
+                continue
+            self.last_lsn = max(self.last_lsn, ev_lsn)
+            events.append(ev)
+        return events
+
+
 def rows_for(store, event: dict):
     """Materialize an event's row payload: (values, validity) dicts for
     inserts; the deleted rows' pre-image for deletes (positions-backed

@@ -248,6 +248,44 @@ _register(ConfigVar(
     "by-disk-size strategy weights).",
     str, validate=_validate_tenant_weights))
 
+# --- serving layer (serving/ — fast-path router + prepared-statement
+# caching taken to inference-serving batching, PystachIO-style) ------------
+_register(ConfigVar(
+    "serving_enabled", True,
+    "Route fast-path point-index lookups through the per-data_dir "
+    "cross-session micro-batcher (serving/batcher.py): concurrent "
+    "lookups coalesce into one batched stripe/chunk probe, single-"
+    "flight when alone so an idle system adds no latency.  Also gates "
+    "the CDC-invalidated result cache (serving_result_cache_bytes). "
+    "Off restores the per-statement solo path (ref: the fast-path "
+    "router + local plan cache pair this layer generalizes, "
+    "planner/fast_path_router_planner.c:530 + local_plan_cache.c).",
+    bool))
+_register(ConfigVar(
+    "serving_max_batch", 64,
+    "Ceiling on point lookups coalesced into ONE batched index probe "
+    "per dispatch; arrivals beyond it form the next batch.  No direct "
+    "reference GUC — the analogue is an inference server's "
+    "max_batch_size.",
+    int, min_value=1, max_value=4096))
+_register(ConfigVar(
+    "serving_batch_window_ms", 2.0,
+    "How long a batch leader that found company holds the door open "
+    "for the burst's tail before dispatching.  0 dispatches whatever "
+    "is queued immediately; a lone request NEVER waits (single-"
+    "flight).",
+    float, min_value=0.0, max_value=1000.0))
+_register(ConfigVar(
+    "serving_result_cache_bytes", 256 << 20,
+    "Byte budget for the shared per-data_dir result cache of repeated "
+    "read statements (serving/result_cache.py).  Freshness is CDC-"
+    "driven — entries drop when the change journal shows a write to a "
+    "table they read, never on a wall-clock TTL — with a manifest-"
+    "identity backstop for mutations the journal missed.  0 disables "
+    "(ref: prepared-statement caching, planner/local_plan_cache.c, "
+    "taken one level further to the finished result).",
+    int, min_value=0, max_value=1 << 40))
+
 # --- columnar storage (ref: columnar GUCs + columnar.options catalog) -----
 _register(ConfigVar(
     "columnar_stripe_row_limit", 150_000,
@@ -351,6 +389,10 @@ class Settings:
 
     def __init__(self, overrides: dict[str, Any] | None = None):
         self._values: dict[str, Any] = {}
+        # bumped on every mutation; consumers (the serving result
+        # cache's key memo) cache derived fingerprints per version
+        self.version = 0
+        self._profile: tuple | None = None
         for name, value in (overrides or {}).items():
             self.set(name, value)
 
@@ -392,12 +434,33 @@ class Settings:
         if var.validate is not None:
             var.validate(value)
         self._values[name] = value
+        self.version += 1
+        self._profile = None
 
     def reset(self, name: str) -> None:
         self._values.pop(name, None)
+        self.version += 1
+        self._profile = None
 
     def show_all(self) -> dict[str, Any]:
         return {name: self.get(name) for name in sorted(_REGISTRY)}
+
+    def profile(self) -> tuple:
+        """The full settings profile as a sorted, hashable tuple —
+        cached per version so hot paths (the serving result-cache key
+        covers every knob) don't re-enumerate the registry per call.
+
+        The memo is stamped with the version read BEFORE enumerating:
+        a SET racing a concurrent statement can install a stale tuple,
+        but the stamp no longer matches and the next call recomputes —
+        a plain `None` sentinel would let the stale tuple (and the
+        result-cache keys built from it) persist until the next SET."""
+        p = self._profile
+        if p is None or p[0] != self.version:
+            v = self.version
+            p = (v, tuple(sorted(self.show_all().items())))
+            self._profile = p
+        return p[1]
 
     @contextlib.contextmanager
     def override(self, **kwargs):
@@ -408,3 +471,5 @@ class Settings:
             yield self
         finally:
             self._values = saved
+            self.version += 1
+            self._profile = None

@@ -189,10 +189,17 @@ class FeedCache:
     def __init__(self, max_bytes: int = 4 << 30):
         self.max_bytes = max_bytes
         self._entries: OrderedDict[tuple, CachedFeed] = OrderedDict()
+        # per-table key index (key layout: (table, version, ...)):
+        # every DML bumps the written table's data version and calls
+        # invalidate_table — scanning the WHOLE entry dict under the
+        # lock on each write serialized concurrent small writers behind
+        # reader traffic for nothing
+        self._by_table: dict[str, set] = {}
         self._lock = threading.Lock()
         self._total_bytes = 0
         self.hits = 0
         self.misses = 0
+        self.invalidations = 0
 
     def get(self, key: tuple) -> CachedFeed | None:
         with self._lock:
@@ -204,32 +211,45 @@ class FeedCache:
                 self.misses += 1
             return e
 
+    def _pop_locked(self, key: tuple) -> None:
+        self._total_bytes -= self._entries.pop(key).nbytes
+        keys = self._by_table.get(key[0])
+        if keys is not None:
+            keys.discard(key)
+            if not keys:
+                del self._by_table[key[0]]
+
     def put(self, key: tuple, feed: CachedFeed) -> None:
         if self.max_bytes <= 0:
             return
         with self._lock:
             if key in self._entries:
-                self._total_bytes -= self._entries.pop(key).nbytes
+                self._pop_locked(key)
             self._entries[key] = feed
+            self._by_table.setdefault(key[0], set()).add(key)
             self._total_bytes += feed.nbytes
             while self._total_bytes > self.max_bytes \
                     and len(self._entries) > 1:
-                _, old = self._entries.popitem(last=False)
-                self._total_bytes -= old.nbytes
+                self._pop_locked(next(iter(self._entries)))
 
     def invalidate_table(self, table: str, keep_version: int | None = None
                          ) -> None:
-        """Drop entries for `table` (key layout: (table, version, ...));
-        keep_version spares the current version's entries."""
+        """Drop entries for `table` via the per-table key index (no
+        full-cache scan); keep_version spares the current version's
+        entries."""
         with self._lock:
-            stale = [k for k in self._entries
-                     if k[0] == table and k[1] != keep_version]
+            keys = self._by_table.get(table)
+            if not keys:
+                return
+            stale = [k for k in keys if k[1] != keep_version]
             for k in stale:
-                self._total_bytes -= self._entries.pop(k).nbytes
+                self._pop_locked(k)
+            self.invalidations += len(stale)
 
     def clear(self) -> None:
         with self._lock:
             self._entries.clear()
+            self._by_table.clear()
             self._total_bytes = 0
 
     @property

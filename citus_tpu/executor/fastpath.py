@@ -181,19 +181,10 @@ def _scan_host(executor, node: ScanNode):
 
     value = index_probe(executor, node)
     if value is not None and len(wanted) == 1:
-        from ..storage import pkindex
-
-        hits = pkindex.lookup(executor.store, node.rel.table,
-                              wanted[0].shard_id,
-                              meta.distribution_column, value)
-        if hits is not None:
-            if executor.counters is not None:
-                from ..stats import counters as sc
-
-                executor.counters.increment(sc.POINT_INDEX_LOOKUPS)
-            vals, mask, n = pkindex.read_rows(
-                executor.store, node.rel.table, wanted[0].shard_id,
-                colnames, hits)
+        got = _index_rows(executor, node.rel.table, wanted[0].shard_id,
+                          meta.distribution_column, value, colnames)
+        if got is not None:
+            vals, mask, n = got
             cols = {cid: vals[cname]
                     for cid, cname in zip(node.columns, colnames)}
             nulls = {cid: ~mask[cname]
@@ -238,6 +229,63 @@ def _scan_host(executor, node: ScanNode):
             predicate_mask(node.filter, ColumnSource(cols, nulls), np)),
             (n,))
     return _compress(cols, nulls, valid)
+
+
+def _index_rows(executor, table: str, shard_id: int, column: str,
+                value: int, colnames):
+    """Point-index rows for one key — through the cross-session
+    micro-batcher (serving/batcher.py) when the serving layer is on,
+    solo otherwise.  None ⇒ the index cannot answer (overlay appeared):
+    the caller falls back to the ordinary scan path."""
+    from ..storage import pkindex
+
+    store = executor.store
+    if executor.settings.get("serving_enabled") \
+            and store.overlay is None \
+            and executor.settings.get("storage_verify_checksums"):
+        # only overlay-free sessions batch: an open transaction's staged
+        # state (records AND delete masks) is session-private, resolved
+        # against this session's own store — it must neither be missed
+        # by another session's probe store (read-your-writes: a staged
+        # DELETE stays visible through the records-only index guard)
+        # nor answer other sessions (dirty read of uncommitted deletes).
+        # And only verify-on sessions batch: the coalesced probe reads
+        # through ONE member's store, so a verify-off session leading
+        # the group would hand unverified bytes to sessions that never
+        # opted out of the PR 7 integrity invariant
+        batcher = getattr(store, "_serving_batcher", None)
+        if batcher is None:
+            # resolve the per-data_dir batcher once per store (the
+            # registry realpath-walks the path on every call)
+            from ..serving.batcher import batcher_for
+
+            batcher = store._serving_batcher = batcher_for(store.data_dir)
+        res = batcher.lookup(
+            store, table, shard_id, column, value, colnames,
+            max_batch=executor.settings.get("serving_max_batch"),
+            window_s=executor.settings.get(
+                "serving_batch_window_ms") / 1000.0)
+        if res.fallback:
+            return None
+        if executor.counters is not None:
+            from ..stats import counters as sc
+
+            executor.counters.increment(sc.POINT_INDEX_LOOKUPS)
+            # requester-side fold: this session's lookup rode a batch;
+            # the leader additionally owns the dispatches it drove
+            executor.counters.increment(sc.SERVING_BATCHED_LOOKUPS_TOTAL)
+            if res.dispatches_led:
+                executor.counters.increment(
+                    sc.SERVING_BATCH_DISPATCH_TOTAL, res.dispatches_led)
+        return res.vals, res.mask, res.n
+    hits = pkindex.lookup(store, table, shard_id, column, value)
+    if hits is None:
+        return None
+    if executor.counters is not None:
+        from ..stats import counters as sc
+
+        executor.counters.increment(sc.POINT_INDEX_LOOKUPS)
+    return pkindex.read_rows(store, table, shard_id, colnames, hits)
 
 
 def _compress(cols, nulls, valid):

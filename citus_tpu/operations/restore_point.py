@@ -180,3 +180,9 @@ def restore_cluster(data_dir: str, name: str) -> None:
                                       os.path.join(d, f))
                 else:
                     shutil.copy2(s, d)
+    # the serving result cache holds finished answers keyed to the
+    # storage just replaced: drop it eagerly (the manifest-identity
+    # backstop + journal-regression check would catch it lazily)
+    from ..serving.result_cache import reset_serving_state
+
+    reset_serving_state(data_dir)

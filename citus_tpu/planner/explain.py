@@ -49,6 +49,7 @@ EXPLAIN_TAGS: dict[str, str] = {
     "Integrity": "stripes CRC-verified / read-repaired this statement",
     "Caches": "plan/feed cache traffic for this statement",
     "Workload": "admission-gate trip for this statement",
+    "Serving": "micro-batch / result-cache trip for this statement",
 }
 
 

@@ -516,11 +516,14 @@ class DistributedPlanner:
             # BParam counts: pruning is host-side per execution, so the
             # bound value is usable even in a generic plan (the deferred
             # param-pruning of CitusBeginScan, citus_custom_scan.c:213)
-            if (isinstance(f, ir.BCmp) and f.op == "="
-                    and isinstance(f.left, ir.BCol) and f.left.cid == dist_cid
-                    and isinstance(f.right, (ir.BConst, ir.BParam))
-                    and f.right.value is not None):
-                values = [f.right.value]
+            if isinstance(f, ir.BCmp) and f.op == "=":
+                col, lit = f.left, f.right
+                if not (isinstance(col, ir.BCol) and col.cid == dist_cid):
+                    col, lit = f.right, f.left  # literal-first: 5 = k
+                if isinstance(col, ir.BCol) and col.cid == dist_cid \
+                        and isinstance(lit, (ir.BConst, ir.BParam)) \
+                        and lit.value is not None:
+                    values = [lit.value]
             elif (isinstance(f, ir.BInConst) and not f.negated
                     and isinstance(f.operand, ir.BCol)
                     and f.operand.cid == dist_cid):

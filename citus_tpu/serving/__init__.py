@@ -1,0 +1,36 @@
+"""Serving layer: cross-session micro-batched point reads + a
+CDC-invalidated result cache.
+
+The reference serves high-QPS point-read traffic through two
+amortizations: the fast-path router planner skips distributed planning
+for ``distcol = const`` statements (fast_path_router_planner.c:530) and
+prepared-statement caching reuses the shard plan across EXECUTEs
+(planner/local_plan_cache.c).  PystachIO (PAPERS.md) adds the
+inference-serving move for accelerator query engines: coalesce many
+concurrent small requests into one batched device dispatch so the fixed
+per-request cost amortizes across the batch.
+
+This package is that layer for the TPU-native engine:
+
+* ``classify``  — the ONE parse-tree fast-path point-read shape
+  classifier, shared by WLM admission exemption and the serving path
+  (one matcher, two call sites — they can never drift);
+* ``batcher``   — a per-data_dir cross-session micro-batcher: point-
+  index lookups from concurrent sessions coalesce into one batched
+  stripe/chunk probe over the union of keys, demuxed back per session
+  (single-flight when idle, so an unloaded system adds no latency);
+* ``result_cache`` — a per-data_dir LRU of finished read-statement
+  results keyed on (statement shape, bound params, catalog version),
+  invalidated by consuming the CDC manifest-delta journal per table —
+  never by wall-clock TTLs — with a manifest-identity backstop for the
+  post-visibility crash window cdc.append leaves open.
+"""
+
+from .batcher import MicroBatcher, batcher_for
+from .classify import PointRead, classify_point_read
+from .result_cache import ResultCache, result_cache_for, reset_serving_state
+
+__all__ = [
+    "MicroBatcher", "PointRead", "ResultCache", "batcher_for",
+    "classify_point_read", "reset_serving_state", "result_cache_for",
+]

@@ -48,7 +48,7 @@ _UDFS = ("create_distributed_table", "create_reference_table",
          "citus_stat_counters", "citus_stat_counters_reset",
          "citus_stat_statements", "citus_stat_statements_reset",
          "citus_stat_tenants", "citus_stat_activity", "citus_stat_wlm",
-         "get_rebalance_progress",
+         "citus_stat_serving", "get_rebalance_progress",
          "citus_split_shard_by_split_points", "isolate_tenant_to_node",
          "citus_cleanup_orphaned_resources",
          "citus_rebalance_start", "citus_rebalance_wait",
@@ -139,6 +139,21 @@ class Session:
         self._cancel_evt = threading.Event()
         # PREPARE registry: name → statement AST (session-scoped, like PG)
         self._prepared: dict[str, ast.Statement] = {}
+        # hot-statement memo: script text → parsed statement tuple.
+        # Frozen AST nodes are reusable value objects, so a repeated
+        # statement (the serving workload) skips the lexer/parser AND
+        # replays the SAME tree — which lets the result-cache key memo
+        # ride on the node (result_cache.cache_key).  Plain dict ops
+        # only (GIL-atomic; Session.execute supports concurrent
+        # callers), reset wholesale when full.
+        self._hot_stmts: dict[str, tuple] = {}
+        # per-session handle to the shared serving result cache (the
+        # registry lookup realpath-walks the data_dir; resolve once).
+        # Guarded: concurrent execute() racing check-then-acquire would
+        # take TWO registry refs for one session and close() releases
+        # only one — pinning the cache bytes for the process lifetime
+        self._result_cache_handle = None
+        self._result_cache_mu = threading.Lock()
         # EXECUTE args visible to recursive planning (subqueries run
         # BEFORE the outer binder sees the params; thread-local because
         # Session.execute supports concurrent callers)
@@ -203,9 +218,15 @@ class Session:
         from .stats import counters as sc
         from .storage import integrity as _integrity
 
+        stmts = self._hot_stmts.get(sql)
+        if stmts is None:
+            stmts = tuple(parse(sql))
+            if len(self._hot_stmts) >= 512:
+                self._hot_stmts.clear()
+            self._hot_stmts[sql] = stmts
         with self.stats.activity.track(sql) as activity:
             t0 = _time.perf_counter()
-            for stmt in parse(sql):
+            for stmt in stmts:
                 activity.retries = 0
                 activity.read_repairs = 0
                 # per-STATEMENT snapshot (like the retries reset): the
@@ -591,6 +612,13 @@ class Session:
         self.maintenance.stop()
         self.jobs.shutdown()
         self._save_catalog()
+        with self._result_cache_mu:
+            handle, self._result_cache_handle = \
+                self._result_cache_handle, None
+        if handle is not None:
+            from .serving.result_cache import release_result_cache
+
+            release_result_cache(self.data_dir)
 
     # -- change data capture ----------------------------------------------
     def change_events(self, table: str | None = None,
@@ -985,6 +1013,34 @@ class Session:
                  "queue_wait_ms_total":
                      [snap["queue_wait_ms_total"]] * len(rows)},
                 len(rows))
+        elif e.name == "citus_stat_serving":
+            # serving-layer snapshot: the shared micro-batcher's ledger
+            # totals + the result cache's traffic for this data_dir
+            # (one row; per-session folds live in citus_stat_counters)
+            from .serving.batcher import batcher_for
+            from .serving.result_cache import result_cache_for
+
+            b = batcher_for(self.data_dir).snapshot()
+            c = result_cache_for(self.data_dir).snapshot()
+            cols = {
+                "requests_total": b["requests_total"],
+                "answered_total": b["answered_total"],
+                "errored_total": b["errored_total"],
+                "fallback_total": b["fallback_total"],
+                "batch_dispatch_total": b["batch_dispatch_total"],
+                "batched_lookups_total": b["batched_lookups_total"],
+                "max_batch_seen": b["max_batch_seen"],
+                "avg_batch_occupancy": b["avg_batch_occupancy"],
+                "queue_depth": b["queue_depth"],
+                "cache_entries": c["entries"],
+                "cache_bytes": c["bytes"],
+                "cache_hits_total": c["hits_total"],
+                "cache_misses_total": c["misses_total"],
+                "cache_invalidations_total": c["invalidations_total"],
+                "cache_last_lsn": c["last_lsn"],
+            }
+            return ResultSet(list(cols),
+                             {k: [v] for k, v in cols.items()}, 1)
         elif e.name == "get_rebalance_progress":
             mons = self.stats.progress.all()
             return ResultSet(
@@ -1315,14 +1371,74 @@ class Session:
                 self._drop_temp(t)
 
     # -- SELECT ------------------------------------------------------------
+    def _serving_cache(self):
+        """The shared per-data_dir result cache, or None when serving is
+        off, the byte budget is zero, or this session is inside an open
+        transaction (staged overlay rows are session-private — neither
+        a fill nor a hit may cross the transaction boundary)."""
+        if self.txn_manager.current is not None:
+            return None
+        if not self.settings.get("serving_enabled") or \
+                self.settings.get("serving_result_cache_bytes") <= 0:
+            return None
+        if self._result_cache_handle is None:
+            from .serving.result_cache import acquire_result_cache
+
+            with self._result_cache_mu:
+                if self._result_cache_handle is None:
+                    self._result_cache_handle = acquire_result_cache(
+                        self.data_dir)
+        return self._result_cache_handle
+
     def _execute_select(self, sel: ast.Select, params: tuple = ()):
+        from .stats import counters as sc
+
+        # serving result cache: a repeated read statement serves from
+        # the shared LRU, provably as-of the latest journaled LSN for
+        # every table it reads (CDC-driven invalidation + the manifest-
+        # identity backstop — serving/result_cache.py, ROADMAP item 3)
+        fill = None
+        cache = self._serving_cache()
+        if cache is not None:
+            from .serving.result_cache import cache_key
+
+            keyed = cache_key(sel, params, self.catalog, self.settings,
+                              _UDFS)
+            if keyed is not None:
+                key, tables = keyed
+                hit, d_inv = cache.lookup(
+                    key, self.store.manifest_stat_sig)
+                if d_inv:  # this statement's poll did the dropping
+                    self.stats.counters.increment(
+                        sc.SERVING_CACHE_INVALIDATIONS_TOTAL, d_inv)
+                if hit is not None:
+                    self.stats.counters.increment(
+                        sc.SERVING_CACHE_HITS_TOTAL)
+                    # fresh metadata, shared (immutable) column arrays:
+                    # a cached answer did no device work of its own
+                    return dc_replace(hit, retries=0,
+                                      device_rows_scanned=0,
+                                      streamed_batches=0)
+                self.stats.counters.increment(sc.SERVING_CACHE_MISSES_TOTAL)
+                # freshness tokens captured BEFORE execution: a write
+                # landing mid-execution invalidates this fill (epoch)
+                # or the entry itself (manifest identity re-check)
+                fill = (key, tables,
+                        {t: self.store.manifest_stat_sig(t)
+                         for t in tables},
+                        cache.fill_token())
         plan, cleanup = self._plan_select(sel, params)
         self._count_plan_shape(plan)
         try:
-            return self.executor.execute_plan(plan)
+            result = self.executor.execute_plan(plan)
         finally:
             for t in cleanup:
                 self._drop_temp(t)
+        if fill is not None:
+            key, tables, sigs, token = fill
+            cache.put(key, result, tables, sigs, token,
+                      self.settings.get("serving_result_cache_bytes"))
+        return result
 
     # -- PREPARE / EXECUTE -------------------------------------------------
     def _execute_prepared(self, stmt: "ast.ExecutePrepared"):
@@ -1499,7 +1615,8 @@ class Session:
                     f"{fc.hits - cache0[2]} misses="
                     f"{fc.misses - cache0[3]} (session totals: plan "
                     f"{pc.hits}/{pc.misses}, feed {fc.hits}/{fc.misses}"
-                    " hits/misses)")
+                    f" hits/misses, feed invalidations="
+                    f"{fc.invalidations})")
                 # this statement's trip through the admission gate (the
                 # EXPLAIN ANALYZE statement itself was the admitted
                 # unit), plus session totals like the Resilience line
@@ -1525,6 +1642,42 @@ class Session:
                         f"feed_bytes={info['feed_bytes']} "
                         f"(session totals: wlm_admitted_total={w_adm} "
                         f"wlm_queued_total={w_q} wlm_shed_total={w_s})")
+                # serving layer: this statement's micro-batch trip
+                # (counter deltas, Chunks Skipped pattern) + whether its
+                # result is cache-resident, + the shared layer's batch
+                # occupancy so the amortization is auditable inline
+                if not self.settings.get("serving_enabled"):
+                    lines.append(f"{explain_tag('Serving')}: off")
+                else:
+                    from .serving.batcher import batcher_for
+
+                    bsnap = batcher_for(self.data_dir).snapshot()
+                    d_bl = snap.get(sc.SERVING_BATCHED_LOOKUPS_TOTAL, 0) \
+                        - snap0.get(sc.SERVING_BATCHED_LOOKUPS_TOTAL, 0)
+                    d_bd = snap.get(sc.SERVING_BATCH_DISPATCH_TOTAL, 0) \
+                        - snap0.get(sc.SERVING_BATCH_DISPATCH_TOTAL, 0)
+                    rcache = self._serving_cache()
+                    cstate = "off"
+                    if rcache is not None:
+                        from .serving.result_cache import cache_key
+
+                        keyed = cache_key(target, params, self.catalog,
+                                          self.settings, _UDFS)
+                        if keyed is None:
+                            cstate = "uncacheable"
+                        elif rcache.probe(keyed[0]):
+                            cstate = "cached"
+                        else:
+                            cstate = "uncached"
+                    ch = snap.get(sc.SERVING_CACHE_HITS_TOTAL, 0)
+                    cm = snap.get(sc.SERVING_CACHE_MISSES_TOTAL, 0)
+                    lines.append(
+                        f"{explain_tag('Serving')}: "
+                        f"batched lookups={d_bl} dispatches led={d_bd} "
+                        f"result-cache={cstate} (layer: avg batch "
+                        f"occupancy={bsnap['avg_batch_occupancy']} "
+                        f"max_batch_seen={bsnap['max_batch_seen']}; "
+                        f"session totals: cache hits={ch} misses={cm})")
             return ResultSet(["QUERY PLAN"], {"QUERY PLAN": lines},
                              len(lines))
         finally:

@@ -16,10 +16,12 @@ from contextlib import contextmanager
 
 GPID_NODE_FACTOR = 10_000_000_000  # reference encoding: nodeid*10^10 + pid
 
+_PID = os.getpid()  # per-statement getpid() syscalls add up at high QPS
+
 
 def make_gpid(node_id: int, pid: int | None = None) -> int:
     return node_id * GPID_NODE_FACTOR + (pid if pid is not None
-                                         else os.getpid())
+                                         else _PID)
 
 
 @dataclass

@@ -49,6 +49,14 @@ WLM_ADMITTED_TOTAL = "wlm_admitted_total"
 WLM_QUEUED_TOTAL = "wlm_queued_total"
 WLM_SHED_TOTAL = "wlm_shed_total"
 WLM_QUEUE_WAIT_MS = "wlm_queue_wait_ms"
+# serving layer (serving/ — cross-session micro-batcher + CDC-
+# invalidated result cache; requester-side folds, the shared-layer
+# totals live on the batcher/cache and surface via citus_stat_serving)
+SERVING_BATCHED_LOOKUPS_TOTAL = "serving_batched_lookups_total"
+SERVING_BATCH_DISPATCH_TOTAL = "serving_batch_dispatch_total"
+SERVING_CACHE_HITS_TOTAL = "serving_cache_hits_total"
+SERVING_CACHE_MISSES_TOTAL = "serving_cache_misses_total"
+SERVING_CACHE_INVALIDATIONS_TOTAL = "serving_cache_invalidations_total"
 # storage integrity (storage/integrity.py read-path accounting folded
 # in per statement; scrub counters from operations/scrubber.py)
 STRIPES_VERIFIED_TOTAL = "stripes_verified_total"
@@ -69,6 +77,9 @@ ALL_COUNTERS = [
     FAULTS_INJECTED_TOTAL,
     WLM_ADMITTED_TOTAL, WLM_QUEUED_TOTAL, WLM_SHED_TOTAL,
     WLM_QUEUE_WAIT_MS,
+    SERVING_BATCHED_LOOKUPS_TOTAL, SERVING_BATCH_DISPATCH_TOTAL,
+    SERVING_CACHE_HITS_TOTAL, SERVING_CACHE_MISSES_TOTAL,
+    SERVING_CACHE_INVALIDATIONS_TOTAL,
     STRIPES_VERIFIED_TOTAL, CORRUPTION_DETECTED_TOTAL,
     READ_REPAIRS_TOTAL, SCRUB_RUNS_TOTAL, SCRUB_REPAIRS_TOTAL,
 ]

@@ -13,8 +13,17 @@ from dataclasses import dataclass, field
 from ..sql.lexer import tokenize
 
 
+_fp_memo: dict[str, str] = {}
+
+
 def fingerprint(sql: str) -> str:
-    """Normalized statement text: literals replaced with '?'."""
+    """Normalized statement text: literals replaced with '?'.
+    Memoized — the serving workload records the same hot texts at high
+    QPS, and re-lexing each one is pure overhead (dict ops only,
+    GIL-atomic; reset wholesale when full)."""
+    fp = _fp_memo.get(sql)
+    if fp is not None:
+        return fp
     try:
         toks = tokenize(sql)
     except Exception:
@@ -27,7 +36,11 @@ def fingerprint(sql: str) -> str:
             break
         else:
             out.append(t.value)
-    return " ".join(out)
+    fp = " ".join(out)
+    if len(_fp_memo) >= 4096:
+        _fp_memo.clear()
+    _fp_memo[sql] = fp
+    return fp
 
 
 @dataclass

@@ -146,25 +146,49 @@ def lookup(store, table: str, shard_id: int, column: str,
 def read_rows(store, table: str, shard_id: int, columns: list[str],
               hits) -> tuple[dict, dict, int]:
     """Materialize the hit rows (values, validity, n), reading only the
-    chunks that contain them and honoring current deletion bitmaps."""
+    chunks that contain them and honoring current deletion bitmaps.
+    One-request wrapper over the batched reader below."""
+    return read_rows_multi(store, table, shard_id, columns, [hits])[0]
+
+
+def read_rows_multi(store, table: str, shard_id: int,
+                    columns: list[str],
+                    hit_lists) -> list[tuple[dict, dict, int]]:
+    """Batched `read_rows`: ONE stripe/chunk pass over the union of many
+    keys' hits, demuxed back per request — the serving micro-batcher's
+    gather (a chunk holding rows for several concurrent sessions is
+    opened, CRC-verified and decompressed once, not once per session).
+
+    Returns [(values, validity, n)] aligned with `hit_lists`.  Per-
+    request row order matches the solo path exactly: lookup() emits
+    hits stripe-major (stable argsort over the build order), and the
+    demux walks stripes in manifest order."""
     meta = store.catalog.table(table)
     storage_of = {c: store.storage_column_name(table, c) for c in columns}
-    by_stripe: dict[str, list[int]] = {}
+    n_req = len(hit_lists)
+    # union of (request, position) pairs per stripe, + manifest order so
+    # every request's rows come back in its own solo order
+    by_stripe: dict[str, list[tuple[int, int]]] = {}
     rec_of: dict[str, dict] = {}
-    for rec, pos in hits:
-        by_stripe.setdefault(rec["file"], []).append(pos)
-        rec_of[rec["file"]] = rec
-    vals_out = {c: [] for c in columns}
-    mask_out = {c: [] for c in columns}
-    n = 0
-    for fname, positions in by_stripe.items():
+    for ri, hits in enumerate(hit_lists):
+        for rec, pos in hits:
+            by_stripe.setdefault(rec["file"], []).append((ri, pos))
+            rec_of[rec["file"]] = rec
+    manifest_order = {r["file"]: i for i, r in enumerate(
+        store.manifest(table)["shards"].get(str(shard_id), []))}
+    vals_out = [{c: [] for c in columns} for _ in range(n_req)]
+    mask_out = [{c: [] for c in columns} for _ in range(n_req)]
+    counts = [0] * n_req
+    for fname in sorted(by_stripe,
+                        key=lambda f: manifest_order.get(f, 1 << 30)):
         rec = rec_of[fname]
         dmask = store.effective_delete_mask(table, shard_id, rec)
-        live = [p for p in positions
+        live = [(ri, p) for ri, p in by_stripe[fname]
                 if dmask is None or not bool(dmask[p])]
         if not live:
             continue
-        pos_arr = np.asarray(live, dtype=np.int64)
+        pos_arr = np.asarray([p for _ri, p in live], dtype=np.int64)
+        req_ids = np.asarray([ri for ri, _p in live], dtype=np.int64)
 
         def read_one(path):
             reader = StripeReader(path, verify=store._verify_enabled())
@@ -190,26 +214,33 @@ def read_rows(store, table: str, shard_id: int, columns: list[str],
             table, shard_id, fname, read_one)
         local = pos_arr + np.asarray(
             [offset_of[int(c)] for c in chunk_of], dtype=np.int64)
+        for ri in np.unique(req_ids):
+            sel_req = req_ids == ri
+            rl = local[sel_req]
+            ri = int(ri)
+            for c in columns:
+                s = storage_of[c]
+                if s in v:
+                    vals_out[ri][c].append(np.asarray(v[s])[rl])
+                    mask_out[ri][c].append(np.asarray(m[s])[rl])
+                else:  # post-ALTER column: NULL for old stripes
+                    dt = meta.schema.column(c).dtype.numpy_dtype
+                    vals_out[ri][c].append(np.zeros(rl.size, dtype=dt))
+                    mask_out[ri][c].append(np.zeros(rl.size, dtype=bool))
+            counts[ri] += int(rl.size)
+    out = []
+    for ri in range(n_req):
+        out_v, out_m = {}, {}
         for c in columns:
-            s = storage_of[c]
-            if s in v:
-                vals_out[c].append(np.asarray(v[s])[local])
-                mask_out[c].append(np.asarray(m[s])[local])
-            else:  # post-ALTER column: NULL for old stripes
+            if vals_out[ri][c]:
+                out_v[c] = np.concatenate(vals_out[ri][c])
+                out_m[c] = np.concatenate(mask_out[ri][c])
+            else:
                 dt = meta.schema.column(c).dtype.numpy_dtype
-                vals_out[c].append(np.zeros(local.size, dtype=dt))
-                mask_out[c].append(np.zeros(local.size, dtype=bool))
-        n += local.size
-    out_v, out_m = {}, {}
-    for c in columns:
-        if vals_out[c]:
-            out_v[c] = np.concatenate(vals_out[c])
-            out_m[c] = np.concatenate(mask_out[c])
-        else:
-            dt = meta.schema.column(c).dtype.numpy_dtype
-            out_v[c] = np.zeros(0, dtype=dt)
-            out_m[c] = np.zeros(0, dtype=bool)
-    return out_v, out_m, n
+                out_v[c] = np.zeros(0, dtype=dt)
+                out_m[c] = np.zeros(0, dtype=bool)
+        out.append((out_v, out_m, counts[ri]))
+    return out
 
 
 class _IndexChunkFilter:

@@ -192,6 +192,20 @@ class TableStore:
         with self._lock:
             return self._data_versions.get(table, 0)
 
+    def manifest_stat_sig(self, table: str) -> tuple | None:
+        """The on-disk manifest's identity (mtime_ns, size, inode), or
+        None when the table has no manifest yet.  Cross-session
+        comparable (unlike the per-store data_version counter): the
+        serving result cache records it at fill time and re-checks on
+        every hit — the backstop for mutations the CDC journal missed
+        (a crash in the post-visibility cdc.append window, out-of-band
+        restore surgery)."""
+        try:
+            st = os.stat(self._manifest_path(table))
+            return (st.st_mtime_ns, st.st_size, st.st_ino)
+        except OSError:
+            return None
+
     def refresh(self, table: str) -> None:
         """Drop the cached manifest so the next read reloads from disk —
         used after lock acquisition so a session sharing this data_dir

@@ -84,6 +84,10 @@ FAULT_POINTS: dict[str, str] = {
     "cdc.append": "cdc/feed.py — change-journal append",
     "operations.shard_move": "operations/shard_transfer.py — mid-move",
     "wlm.admit": "wlm/manager.py — admission gate entry",
+    "serving.batch_dispatch":
+        "serving/batcher.py — coalesced point-lookup batch dispatch",
+    "serving.cache_fill":
+        "serving/result_cache.py — result-cache entry insert",
 }
 
 _lock = threading.Lock()

@@ -50,54 +50,14 @@ def _is_udf_call(sel: ast.Select, udfs) -> bool:
 
 def fastpath_exempt_shape(sel: ast.Select, catalog: Catalog,
                           settings=None) -> bool:
-    """Parse-tree fast-path shape: one hash-distributed table, the
-    distribution column pinned to a literal, no aggregates/subqueries.
-    Mirrors (conservatively) executor/fastpath.fast_path_shape, which
-    re-checks on the bound plan — a statement exempted here that the
-    planner then routes to the device still executes correctly, it
-    just bypassed the gate (the same slack the reference accepts
-    between FastPathRouterQuery and the real router plan)."""
-    if settings is not None and \
-            not settings.get("enable_fast_path_router"):
-        return False
-    if sel.ctes or sel.group_by or sel.having is not None or \
-            sel.distinct or sel.semi_joins:
-        return False
-    if len(sel.from_items) != 1 or \
-            not isinstance(sel.from_items[0], ast.TableRef):
-        return False
-    ref = sel.from_items[0]
-    if not catalog.has_table(ref.name):
-        return False
-    meta = catalog.table(ref.name)
-    if meta.method != DistributionMethod.HASH:
-        return False
-    if sel.where is None:
-        return False
-    # any function call (aggregate or otherwise) or nested subquery
-    # disqualifies — the device path would run it
-    exprs = [it.expr for it in sel.items] + [sel.where]
-    for e in exprs:
-        for n in ast.walk_expr(e):
-            if isinstance(n, (ast.FuncCall, ast.ScalarSubquery,
-                              ast.InSubquery, ast.Exists)):
-                return False
-    from ..executor.host_eval import split_conjuncts
+    """Parse-tree fast-path shape — delegated to the ONE shared matcher
+    (serving/classify.py), so the admission exemption and the serving
+    micro-batcher's eligibility can never drift: a statement that skips
+    the slot gate here is exactly one whose lookups the batcher
+    governs by coalescing instead of queueing."""
+    from ..serving.classify import classify_point_read
 
-    dcol = meta.distribution_column
-    quals = {ref.alias or ref.name, ref.name}
-    for c in split_conjuncts(sel.where):
-        if not (isinstance(c, ast.BinaryOp) and c.op == "="):
-            continue
-        col, lit = c.left, c.right
-        if not isinstance(col, ast.ColumnRef):
-            col, lit = c.right, c.left
-        if isinstance(col, ast.ColumnRef) and \
-                isinstance(lit, ast.Literal) and lit.value is not None \
-                and col.name == dcol and \
-                (col.table is None or col.table in quals):
-            return True
-    return False
+    return classify_point_read(sel, catalog, settings) is not None
 
 
 def statement_exempt(stmt: ast.Statement, catalog: Catalog,

@@ -38,7 +38,7 @@ def open_session(sf: float, tables=("customer", "orders", "lineitem",
     tag = ("sf%g" % sf).replace(".", "_")
     data_dir = os.path.join(REPO, ".benchdata", tag)
     loaded = os.path.exists(os.path.join(data_dir, "catalog.json"))
-    sess = Session(data_dir=data_dir)
+    sess = Session(data_dir=data_dir, serving_result_cache_bytes=0)
     if not loaded or sess.store.table_row_count("lineitem") == 0:
         print(f"# loading TPC-H sf={sf} into {data_dir} ...",
               file=sys.stderr)

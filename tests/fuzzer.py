@@ -524,13 +524,26 @@ def _chaos_delete(rng: random.Random, state: dict,
     return [ChaosStmt(sql, "delete", effect=effect)]
 
 
-def _chaos_read(rng: random.Random) -> list[ChaosStmt]:
-    if rng.random() < 0.5:
+def _chaos_read(rng: random.Random,
+                model_keys: list | None = None) -> list[ChaosStmt]:
+    roll = rng.random()
+    if roll < 0.4:
         def expect(model):
             n = len(model)
             return [(n, sum(model.values()) if n else None)]
 
         return [ChaosStmt("SELECT count(*), sum(v) FROM kv", "read",
+                          expect=expect)]
+    if roll < 0.7 and model_keys:
+        # fast-path point read: rides the serving micro-batcher (and,
+        # repeated, the result cache) — its answer must stay exact
+        # under every armed fault and every interleaved write
+        rid = rng.choice(model_keys)
+
+        def expect(model):
+            return [(model[rid],)] if rid in model else []
+
+        return [ChaosStmt(f"SELECT v FROM kv WHERE id = {rid}", "read",
                           expect=expect)]
     c = rng.choice(CHAOS_FILTER_POOL)
 
@@ -559,6 +572,67 @@ def _chaos_txn(rng: random.Random, state: dict) -> list[ChaosStmt]:
             + [ChaosStmt("COMMIT", "commit", effect=commit_effect)])
 
 
+# ---------------------------------------------------------------------------
+# serving mode: repeated read statements under interleaved writes
+#
+# The serving-fuzz harness (tests/test_serving.py) runs the SAME read on
+# two sessions sharing one data_dir — result cache on vs off — after
+# every step; the cache-off session is the oracle, so cache-on ≡
+# cache-off proves the CDC-driven invalidation (never a TTL) keeps every
+# hit as-of the latest committed write.  Reads repeat from FIXED pools
+# so the cache actually gets hit traffic; writes interleave from a
+# second (writer) session so invalidation is always cross-session.
+
+
+SERVING_HOT_KEYS = list(range(0, 30))      # point reads repeat these
+SERVING_READ_AGGS = [
+    "SELECT count(*), sum(v) FROM kv",
+    "SELECT count(*) FROM kv WHERE v >= 500",
+    "SELECT count(*) FROM kv WHERE v >= 5000",
+]
+
+
+def generate_serving(rng: random.Random, state: dict) -> tuple:
+    """One serving-fuzz step: ("write", sql, rows|None) for the writer
+    session, or ("read", sql, None) run on BOTH reader sessions.  State
+    holds the fresh-id counter ("next_id")."""
+    roll = rng.random()
+    if roll < 0.12:
+        k = rng.randint(1, 3)
+        rows = []
+        for _ in range(k):
+            rid = state["next_id"]
+            state["next_id"] += 1
+            rows.append((rid, rng.choice(CHAOS_FILTER_POOL)))
+        return ("write", "INSERT INTO kv VALUES " + ", ".join(
+            f"({i}, {v})" for i, v in rows), None)
+    if roll < 0.2:
+        lo, hi = rng.choice(CHAOS_RANGE_POOL)
+        d = rng.choice(CHAOS_DELTA_POOL)
+        return ("write", f"UPDATE kv SET v = v + {d} "
+                f"WHERE id >= {lo} AND id < {hi}", None)
+    if roll < 0.25:
+        return ("write",
+                f"DELETE FROM kv WHERE id = {rng.choice(SERVING_HOT_KEYS)}",
+                None)
+    if roll < 0.3:  # COPY: the harness writes the CSV + fills the sql
+        rows = []
+        for _ in range(rng.randint(2, 5)):
+            rid = state["next_id"]
+            state["next_id"] += 1
+            rows.append((rid, rng.choice(CHAOS_FILTER_POOL)))
+        return ("copy", "", rows)
+    if roll < 0.34:  # transactional write: invalidation rides COMMIT
+        lo, hi = rng.choice(CHAOS_RANGE_POOL)
+        d = rng.choice(CHAOS_DELTA_POOL)
+        return ("txn_write", f"UPDATE kv SET v = v + {d} "
+                f"WHERE id >= {lo} AND id < {hi}", None)
+    if roll < 0.75:  # repeated point reads: the cache's bread and butter
+        k = rng.choice(SERVING_HOT_KEYS)
+        return ("read", f"SELECT v FROM kv WHERE id = {k}", None)
+    return ("read", rng.choice(SERVING_READ_AGGS), None)
+
+
 def generate_chaos(rng: random.Random, state: dict,
                    model: dict) -> list[ChaosStmt]:
     """One chaos operation → 1..4 statements (transactions span several).
@@ -566,7 +640,7 @@ def generate_chaos(rng: random.Random, state: dict,
     oracle (read-only here — effects apply it on statement success)."""
     roll = rng.random()
     if roll < 0.30:
-        return _chaos_read(rng)
+        return _chaos_read(rng, sorted(model))
     if roll < 0.50:
         return _chaos_insert(rng, state)
     if roll < 0.65:

@@ -132,3 +132,30 @@ def test_readme_table_matches_newest_artifact(artifact):
                         f"{vs_cpu} ({metric})")
     assert not mismatches, "README bench table is stale:\n  " + \
         "\n  ".join(mismatches)
+
+
+def test_readme_serving_multiplier_matches_artifact(artifact):
+    """The serving section may only quote a driver-stamped batched-vs-
+    per-statement multiplier when the newest artifact actually contains
+    the point_lookup_qps lines — and then it must quote THAT ratio."""
+    with open(os.path.join(ROOT, "README.md")) as f:
+        text = f.read()
+    quoted = re.search(
+        r"(\d+(?:\.\d+)?)× the per-statement baseline \(driver", text)
+    metrics = _artifact_metrics(artifact)
+    full = metrics.get("point_lookup_qps")
+    base = metrics.get("point_lookup_qps_baseline")
+    if full is None or base is None:
+        assert quoted is None, (
+            "README quotes a driver-stamped serving multiplier but "
+            f"{os.path.basename(artifact)} has no point_lookup_qps "
+            "capture")
+        return
+    want = f"{full['value'] / base['value']:.1f}"
+    assert quoted is not None, (
+        f"{os.path.basename(artifact)} captures point_lookup_qps "
+        f"({want}× baseline) but the README serving section quotes no "
+        "driver-stamped multiplier")
+    assert quoted.group(1) == want, (
+        f"README quotes {quoted.group(1)}× but the artifact says "
+        f"{want}×")

@@ -69,6 +69,13 @@ FAULT_POOL = [
     dict(name="storage.manifest_flip"),
     dict(name="storage.stripe_bitflip"),
     dict(name="storage.stripe_bitflip", p=0.5, times=2),
+    # serving seams (PR 8): a fault at batch dispatch must error every
+    # coalesced lookup CLEANLY (the batcher ledger below proves none is
+    # ever lost in a dead batch); a cache-fill fault errors the filling
+    # SELECT cleanly and the retry re-executes (no visibility effect)
+    dict(name="serving.batch_dispatch"),
+    dict(name="serving.batch_dispatch", p=0.5, times=2),
+    dict(name="serving.cache_fill"),
 ]
 
 
@@ -208,6 +215,16 @@ def _run_soak_inner(tmp_path, n_ops: int, seed: int, fault_rate: float):
         + wlm["timedout_total"] + wlm["canceled_total"]), wlm
     assert wlm["slots_in_use"] == 0 and wlm["feed_bytes_admitted"] == 0
     assert wlm["admitted_total"] > 0
+    # the serving micro-batcher lost nothing either: every enqueued
+    # point lookup resolved answered XOR cleanly-errored XOR fallback,
+    # and no dead batch left a queued request or a stuck leader behind
+    from citus_tpu.serving.batcher import batcher_for
+
+    b = batcher_for(data_dir).snapshot()
+    assert b["requests_total"] == (
+        b["answered_total"] + b["errored_total"]
+        + b["fallback_total"]), b
+    assert b["queue_depth"] == 0 and not b["leader_active"], b
     for sess in sessions:
         sess.close()
     fresh.close()

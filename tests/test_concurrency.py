@@ -73,7 +73,11 @@ def test_cached_plan_hits_thread_safe_across_sessions(sess, tmp_path):
     written (dict-changed-size crash), and the plan/feed caches must
     serve torn-free entries under concurrent get/put."""
     sess2 = citus_tpu.connect(data_dir=str(tmp_path / "d"), n_devices=4,
-                              compute_dtype="float64")
+                              compute_dtype="float64",
+                              serving_result_cache_bytes=0)
+    # serving result cache off (both sessions): this test hammers the
+    # PLAN cache — a result-cache hit would short-circuit before it
+    sess.execute("set serving_result_cache_bytes = 0")
     # warm both plan caches so the loop runs on the cached-hit path
     for s in (sess, sess2):
         s.execute("select sum(v), count(*) from cq")

@@ -1,0 +1,802 @@
+"""Serving layer: cross-session micro-batched point reads + the
+CDC-invalidated result cache (citus_tpu/serving/).
+
+Covers the PR-8 acceptance surface:
+
+* ONE fast-path shape classifier shared by WLM admission exemption and
+  the serving layer (a corpus both call sites must classify identically);
+* micro-batcher: single-flight, coalescing, the answered-XOR-cleanly-
+  errored-XOR-fallback ledger under `serving.batch_dispatch` faults;
+* batched index reader (`pkindex.read_rows_multi`) ≡ the solo path;
+* result cache: CDC-driven cross-session invalidation (DML / COPY /
+  txn commit — never a TTL), the manifest-identity backstop for
+  journal-missed writes, LRU byte bound, epoch fill races;
+* `ChangeFeedCursor` incremental journal consumption;
+* FeedCache per-table invalidation index (satellite regression);
+* observability: counters, citus_stat_serving(), EXPLAIN "Serving:";
+* serving fuzz: cache-on ≡ cache-off under interleaved writes
+  (deterministic tier-1 slice; the full run is `slow`).
+"""
+
+import json
+import os
+import random
+import threading
+
+import numpy as np
+import pytest
+
+import citus_tpu
+from citus_tpu.cdc.feed import ChangeFeedCursor
+from citus_tpu.errors import CitusTpuError
+from citus_tpu.executor.cache import CachedFeed, FeedCache
+from citus_tpu.executor.runner import ResultSet
+from citus_tpu.serving import batcher_for, classify_point_read
+from citus_tpu.serving.result_cache import ResultCache, cache_key
+from citus_tpu.sql import parse
+from citus_tpu.stats import counters as sc
+from citus_tpu.storage import pkindex
+from citus_tpu.utils import faultinjection as fi
+from citus_tpu.utils.faultinjection import InjectedFault
+from citus_tpu.wlm import fastpath_exempt_shape
+from citus_tpu.session import _UDFS
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    fi.reset()
+    yield
+    fi.reset()
+
+
+@pytest.fixture()
+def sess(tmp_path):
+    s = citus_tpu.connect(data_dir=str(tmp_path / "d"), n_devices=2)
+    s.execute("create table kv (k bigint, v bigint, s text)")
+    s.create_distributed_table("kv", "k", shard_count=4)
+    s.execute("insert into kv values " + ", ".join(
+        f"({i}, {i * 10}, 'n{i % 5}')" for i in range(200)))
+    s.execute("create table ref (v bigint)")
+    s.execute("select create_reference_table('ref')")
+    s.execute("insert into ref values (10), (20)")
+    yield s
+    s.close()
+
+
+def _second(sess, tmp_path, **kw):
+    return citus_tpu.connect(data_dir=sess.data_dir, n_devices=2, **kw)
+
+
+def _serving_counter(s, name):
+    return s.stats.counters.snapshot().get(name, 0)
+
+
+def _stat_serving(s) -> dict:
+    r = s.execute("select citus_stat_serving()")
+    return dict(zip(r.column_names, r.rows()[0]))
+
+
+# ---------------------------------------------------------------------------
+# ONE shape classifier, two call sites
+
+
+CLASSIFIER_CORPUS = [
+    # (sql, is_point_read)
+    ("select v from kv where k = 5", True),
+    ("select v, s from kv where k = 5 and v > 2", True),
+    ("select v from kv where 5 = k", True),
+    ("select v from kv as t where t.k = 7", True),
+    ("select * from kv", False),
+    ("select v from kv where v = 5", False),          # non-distcol pin
+    ("select count(*) from kv where k = 5", False),   # aggregate
+    ("select v from kv where k = 5 or v = 1", False),  # disjunction
+    ("select v from kv, ref where k = 1", False),     # join
+    ("select v from kv where k = 5 group by v", False),
+    ("select distinct v from kv where k = 5", False),
+    ("select v from ref where v = 10", False),        # reference table
+    ("select v from nope where k = 1", False),        # unknown table
+    ("select v from kv where k in (1, 2)", False),
+    ("select v from kv where k = 1 limit 1", True),
+    ("with c as (select 1) select v from kv where k = 1", False),
+]
+
+
+class TestSharedClassifier:
+    def test_corpus_classified_identically_by_both_call_sites(self, sess):
+        for sql, want in CLASSIFIER_CORPUS:
+            stmt = parse(sql)[0]
+            via_serving = classify_point_read(
+                stmt, sess.catalog, sess.settings) is not None
+            via_wlm = fastpath_exempt_shape(
+                stmt, sess.catalog, sess.settings)
+            assert via_serving == via_wlm == want, sql
+
+    def test_classifier_agrees_with_bound_plan_router(self, sess):
+        """The parse-tree classifier is a conservative mirror of the
+        executor's bound-plan matcher (fast_path_shape +
+        point_lookup_const) — different representations, one behavior.
+        Pin the direction that matters over the corpus: everything the
+        classifier exempts from admission, the executor genuinely
+        routes fast-path (a fastpath.py change that narrows routing
+        without narrowing the exemption fails HERE, not silently).  The
+        reverse direction is allowed slack by design — the reference
+        accepts the same between FastPathRouterQuery and the real
+        router plan."""
+        from citus_tpu.executor.fastpath import (fast_path_shape,
+                                                 point_lookup_const)
+        from citus_tpu.executor.feed import walk_plan
+        from citus_tpu.planner.plan import ScanNode
+
+        for sql, want in CLASSIFIER_CORPUS:
+            if not want:
+                continue
+            stmt = parse(sql)[0]
+            plan, cleanup = sess._plan_select(stmt, ())
+            for t in cleanup:
+                sess._drop_temp(t)
+            assert fast_path_shape(plan, sess.catalog), sql
+            consts = [point_lookup_const(n, sess.catalog, sess.settings)
+                      for n in walk_plan(plan.root)
+                      if isinstance(n, ScanNode)]
+            assert consts and all(c is not None for c in consts), sql
+
+    def test_classification_pins_table_column_value(self, sess):
+        pr = classify_point_read(
+            parse("select v from kv where s = 'x' and k = 42")[0],
+            sess.catalog, sess.settings)
+        assert (pr.table, pr.column, pr.value) == ("kv", "k", 42)
+
+    def test_router_disabled_classifies_nothing(self, sess):
+        stmt = parse("select v from kv where k = 5")[0]
+        with sess.settings.override(enable_fast_path_router=False):
+            assert classify_point_read(
+                stmt, sess.catalog, sess.settings) is None
+            assert not fastpath_exempt_shape(
+                stmt, sess.catalog, sess.settings)
+
+    def test_point_reads_exempt_from_admission(self, sess):
+        before = sess.wlm.snapshot()["requests_total"]
+        sess.execute("select v from kv where k = 11")
+        assert sess.wlm.snapshot()["requests_total"] == before
+
+
+# ---------------------------------------------------------------------------
+# micro-batcher
+
+
+class TestMicroBatcher:
+    def test_single_flight_no_added_latency_path(self, sess):
+        b = batcher_for(sess.data_dir)
+        before = b.snapshot()
+        r = sess.execute("select v from kv where k = 17")
+        assert r.rows() == [(170,)]
+        snap = b.snapshot()
+        assert snap["requests_total"] == before["requests_total"] + 1
+        assert snap["answered_total"] == before["answered_total"] + 1
+        assert snap["queue_depth"] == 0 and not snap["leader_active"]
+
+    def test_concurrent_lookups_coalesce(self, sess, tmp_path,
+                                         monkeypatch):
+        """8 threads across 2 sessions probing concurrently: every
+        answer exact, and (with the batch window held open by a slowed
+        reader) at least one dispatch carried more than one lookup."""
+        # cache off: the repeats must reach the BATCHER, not the cache
+        sess.execute("set serving_result_cache_bytes = 0")
+        s2 = _second(sess, tmp_path, serving_result_cache_bytes=0)
+        real = pkindex.read_rows_multi
+
+        def slowed(*a, **kw):
+            import time
+
+            time.sleep(0.02)  # arrivals pile up behind the leader
+            return real(*a, **kw)
+
+        monkeypatch.setattr(pkindex, "read_rows_multi", slowed)
+        b = batcher_for(sess.data_dir)
+        base = b.snapshot()
+        barrier = threading.Barrier(8)
+        errors: list = []
+
+        def worker(s, key):
+            try:
+                barrier.wait()
+                for _ in range(3):
+                    r = s.execute(f"select v from kv where k = {key}")
+                    assert r.rows() == [(key * 10,)], key
+            except Exception as e:  # pragma: no cover - surfaced below
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker,
+                                    args=((sess, s2)[i % 2], 20 + i))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        snap = b.snapshot()
+        try:
+            assert not errors, errors[0]
+            assert snap["requests_total"] - base["requests_total"] == 24
+            assert snap["answered_total"] - base["answered_total"] == 24
+            assert snap["max_batch_seen"] >= 2
+            assert snap["queue_depth"] == 0 and not snap["leader_active"]
+        finally:
+            s2.close()
+
+    def test_batch_dispatch_fault_errors_whole_batch_cleanly(
+            self, sess, tmp_path):
+        """Ledger invariant: a fault at dispatch resolves EVERY queued
+        lookup as a clean error — none lost in the dead batch — and the
+        next lookup finds a working batcher (no leaked leader slot)."""
+        s2 = _second(sess, tmp_path,
+                     max_statement_retries=0)  # surface, don't retry
+        sess.execute("set max_statement_retries = 0")
+        b = batcher_for(sess.data_dir)
+        base = b.snapshot()
+        fi.arm("serving.batch_dispatch", times=2)
+        outcomes: list = []
+        lock = threading.Lock()
+
+        def worker(s, key):
+            try:
+                r = s.execute(f"select v from kv where k = {key}")
+                with lock:
+                    outcomes.append(("ok", r.rows()))
+            except Exception as e:
+                with lock:
+                    outcomes.append(("err", e))
+
+        threads = [threading.Thread(target=worker,
+                                    args=((sess, s2)[i % 2], 30 + i))
+                   for i in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        fi.reset()
+        try:
+            assert len(outcomes) == 6
+            for kind, payload in outcomes:
+                if kind == "err":  # clean framework error, classified
+                    assert isinstance(payload, CitusTpuError), payload
+            assert any(k == "err" for k, _ in outcomes)
+            snap = b.snapshot()
+            assert snap["requests_total"] - base["requests_total"] == \
+                (snap["answered_total"] - base["answered_total"]) + \
+                (snap["errored_total"] - base["errored_total"]) + \
+                (snap["fallback_total"] - base["fallback_total"])
+            assert snap["queue_depth"] == 0 and not snap["leader_active"]
+            # the batcher still works after the dead batch
+            assert sess.execute(
+                "select v from kv where k = 3").rows() == [(30,)]
+        finally:
+            s2.close()
+
+    def test_batch_dispatch_fault_is_retried_transparently(self, sess):
+        fi.arm("serving.batch_dispatch", times=1)
+        r = sess.execute("select v from kv where k = 77")
+        assert r.rows() == [(770,)]
+        snap = sess.stats.counters.snapshot()
+        assert snap[sc.RETRIES_TOTAL] >= 1
+        assert snap[sc.FAULTS_INJECTED_TOTAL] >= 1
+
+    def test_index_miss_falls_back_to_scan(self, sess, monkeypatch):
+        """lookup() returning None (overlay materialized between
+        eligibility and dispatch) resolves as fallback: the statement
+        still answers via the ordinary scan path."""
+        monkeypatch.setattr(pkindex, "lookup",
+                            lambda *a, **kw: None)
+        b = batcher_for(sess.data_dir)
+        base = b.snapshot()["fallback_total"]
+        r = sess.execute("select v from kv where k = 19")
+        assert r.rows() == [(190,)]
+        assert b.snapshot()["fallback_total"] == base + 1
+
+    def test_open_overlay_session_goes_solo(self, sess, tmp_path):
+        """A session with an open transaction overlay — even a
+        delete-only one, which the index's records-only guard cannot
+        see — must not ride the batcher: staged state is private to its
+        own store.  Riding another session's probe store would un-see
+        its own staged DELETE (read-your-writes), and leading a batch
+        would leak the uncommitted delete to other sessions (dirty
+        read)."""
+        s2 = _second(sess, tmp_path)
+        try:
+            b = batcher_for(sess.data_dir)
+            sess.execute("begin")
+            sess.execute("delete from kv where k = 33")
+            base = b.snapshot()["requests_total"]
+            # read-your-writes: the staged delete is visible, solo
+            assert sess.execute(
+                "select v from kv where k = 33").rows() == []
+            assert b.snapshot()["requests_total"] == base
+            # no dirty read: the other session sees the committed row
+            assert s2.execute(
+                "select v from kv where k = 33").rows() == [(330,)]
+            sess.execute("rollback")
+            assert sess.execute(
+                "select v from kv where k = 33").rows() == [(330,)]
+        finally:
+            s2.close()
+
+    def test_serving_disabled_solo_path_identical(self, sess):
+        with sess.settings.override(serving_enabled=False):
+            b = batcher_for(sess.data_dir)
+            base = b.snapshot()["requests_total"]
+            r = sess.execute("select v from kv where k = 21")
+            assert r.rows() == [(210,)]
+            assert b.snapshot()["requests_total"] == base
+
+    def test_requester_side_counters_fold(self, sess):
+        before = _serving_counter(s=sess,
+                                  name=sc.SERVING_BATCHED_LOOKUPS_TOTAL)
+        sess.execute("select v from kv where k = 23")
+        assert _serving_counter(
+            sess, sc.SERVING_BATCHED_LOOKUPS_TOTAL) == before + 1
+        assert _serving_counter(
+            sess, sc.SERVING_BATCH_DISPATCH_TOTAL) >= 1
+
+
+# ---------------------------------------------------------------------------
+# batched index reader
+
+
+class TestReadRowsMulti:
+    def _hits_by_shard(self, sess, keys):
+        """(shard_id → [(key, hits)]) over `keys` that have index hits."""
+        out: dict[int, list] = {}
+        for shard in sess.catalog.table_shards("kv"):
+            for k in keys:
+                hits = pkindex.lookup(sess.store, "kv", shard.shard_id,
+                                      "k", k)
+                if hits:
+                    out.setdefault(shard.shard_id, []).append((k, hits))
+        return out
+
+    def test_multi_matches_solo(self, sess):
+        by_shard = self._hits_by_shard(sess, list(range(1, 40)))
+        sid, pairs = max(by_shard.items(), key=lambda kv: len(kv[1]))
+        assert len(pairs) >= 3
+        pairs = pairs[:5]
+        cols = ["v", "s", "k"]
+        multi = pkindex.read_rows_multi(
+            sess.store, "kv", sid, cols, [h for _k, h in pairs])
+        for (k, hits), (mv, mm, mn) in zip(pairs, multi):
+            sv, sm, sn = pkindex.read_rows(sess.store, "kv", sid, cols,
+                                           hits)
+            assert mn == sn
+            for c in cols:
+                np.testing.assert_array_equal(mv[c], sv[c])
+                np.testing.assert_array_equal(mm[c], sm[c])
+
+    def test_multi_honors_delete_masks(self, sess):
+        by_shard = self._hits_by_shard(sess, list(range(1, 40)))
+        sid, pairs = max(by_shard.items(), key=lambda kv: len(kv[1]))
+        dead_key = pairs[0][0]
+        sess.execute(f"delete from kv where k = {dead_key}")
+        hits = pkindex.lookup(sess.store, "kv", sid, "k", dead_key)
+        assert hits  # index keeps the entry; the mask kills the row
+        (vals, mask, n), = pkindex.read_rows_multi(
+            sess.store, "kv", sid, ["v"], [hits])
+        assert n == 0 and vals["v"].size == 0
+
+
+# ---------------------------------------------------------------------------
+# result cache: CDC invalidation, backstop, bounds
+
+
+class TestResultCache:
+    def test_repeat_hits_and_stat_serving(self, sess):
+        q = "select v, s from kv where k = 9"
+        sess.execute(q)
+        h0 = _serving_counter(sess, sc.SERVING_CACHE_HITS_TOTAL)
+        r = sess.execute(q)
+        assert r.rows() == [(90, "n4")]
+        assert _serving_counter(
+            sess, sc.SERVING_CACHE_HITS_TOTAL) == h0 + 1
+        stat = _stat_serving(sess)
+        assert stat["cache_hits_total"] >= 1
+        assert stat["cache_entries"] >= 1
+
+    def test_cross_session_dml_invalidates_exactly(self, sess, tmp_path):
+        s2 = _second(sess, tmp_path)
+        try:
+            q_kv = "select v from kv where k = 12"
+            q_ref = "select count(*) from ref"
+            assert sess.execute(q_kv).rows() == [(120,)]
+            sess.execute(q_ref)
+            inv0 = _serving_counter(
+                sess, sc.SERVING_CACHE_INVALIDATIONS_TOTAL)
+            s2.execute("update kv set v = 1 where k = 12")
+            # the touched table's entry drops; the repeat re-executes
+            assert sess.execute(q_kv).rows() == [(1,)]
+            assert _serving_counter(
+                sess, sc.SERVING_CACHE_INVALIDATIONS_TOTAL) > inv0
+            # the untouched table's entry survived and still hits
+            h0 = _serving_counter(sess, sc.SERVING_CACHE_HITS_TOTAL)
+            sess.execute(q_ref)
+            assert _serving_counter(
+                sess, sc.SERVING_CACHE_HITS_TOTAL) == h0 + 1
+        finally:
+            s2.close()
+
+    def test_copy_and_txn_commit_invalidate(self, sess, tmp_path):
+        s2 = _second(sess, tmp_path)
+        try:
+            q = "select count(*) from kv"
+            n0 = int(sess.execute(q).rows()[0][0])
+            csv = str(tmp_path / "more.csv")
+            with open(csv, "w") as f:
+                f.write("9001,1,x\n9002,2,y\n")
+            s2.execute(f"COPY kv FROM '{csv}' WITH (FORMAT csv)")
+            assert int(sess.execute(q).rows()[0][0]) == n0 + 2
+            s2.execute("begin")
+            s2.execute("delete from kv where k = 9001")
+            # not committed yet: the cached count must NOT see it
+            assert int(sess.execute(q).rows()[0][0]) == n0 + 2
+            s2.execute("commit")
+            assert int(sess.execute(q).rows()[0][0]) == n0 + 1
+        finally:
+            s2.close()
+
+    def test_open_transaction_bypasses_cache(self, sess):
+        q = "select v from kv where k = 31"
+        assert sess.execute(q).rows() == [(310,)]
+        sess.execute("begin")
+        sess.execute("update kv set v = 7 where k = 31")
+        m0 = _serving_counter(sess, sc.SERVING_CACHE_MISSES_TOTAL)
+        h0 = _serving_counter(sess, sc.SERVING_CACHE_HITS_TOTAL)
+        assert sess.execute(q).rows() == [(7,)]  # staged row visible
+        # neither a hit nor a fill happened inside the txn
+        assert _serving_counter(
+            sess, sc.SERVING_CACHE_MISSES_TOTAL) == m0
+        assert _serving_counter(sess, sc.SERVING_CACHE_HITS_TOTAL) == h0
+        sess.execute("rollback")
+        assert sess.execute(q).rows() == [(310,)]
+
+    def test_manifest_backstop_catches_journal_missed_write(
+            self, sess, tmp_path):
+        """cdc.append is post-visibility: a committed write whose
+        journal append never landed must STILL invalidate — via the
+        manifest-identity re-check on hit."""
+        s2 = _second(sess, tmp_path)
+        try:
+            q = "select v from kv where k = 44"
+            assert sess.execute(q).rows() == [(440,)]
+            with s2.store.change_log.suppress():  # journal sees nothing
+                s2.execute("update kv set v = 4 where k = 44")
+            assert sess.execute(q).rows() == [(4,)]
+        finally:
+            s2.close()
+
+    def test_no_ttl_entry_valid_until_a_write(self, sess):
+        import time
+
+        q = "select count(*) from kv where v >= 0"
+        sess.execute(q)
+        time.sleep(0.05)  # a TTL-based design would be racy here
+        h0 = _serving_counter(sess, sc.SERVING_CACHE_HITS_TOTAL)
+        sess.execute(q)
+        assert _serving_counter(
+            sess, sc.SERVING_CACHE_HITS_TOTAL) == h0 + 1
+
+    def test_lru_byte_bound_and_oversized_refusal(self, sess):
+        from citus_tpu.serving.result_cache import result_cache_for
+
+        cache = result_cache_for(sess.data_dir)
+        cache.clear()
+        sess.execute("set serving_result_cache_bytes = 4096")
+        for k in range(60, 90):
+            sess.execute(f"select v from kv where k = {k}")
+        assert 0 < cache.total_bytes <= 4096
+        assert 0 < len(cache) < 30
+        # an entry bigger than a quarter of the budget is refused —
+        # one answer must not evict the whole working set
+        sess.execute("set serving_result_cache_bytes = 1000")
+        cache.clear()
+        sess.execute("select k, v, s from kv where v >= 0")
+        assert len(cache) == 0
+
+    def test_cache_fill_fault_is_clean_and_retried(self, sess):
+        q = "select count(*) from kv where v >= -5"
+        fi.arm("serving.cache_fill", times=1)
+        r = sess.execute(q)  # fill faulted → clean retry re-executed
+        assert int(r.rows()[0][0]) == 200
+        assert sess.stats.counters.snapshot()[sc.RETRIES_TOTAL] >= 1
+        sess.execute("set max_statement_retries = 0")
+        fi.arm("serving.cache_fill", times=1)
+        with pytest.raises(InjectedFault):
+            sess.execute("select count(*) from kv where v >= -6")
+
+    def test_uncacheable_statements_skip_the_cache(self, sess):
+        m0 = _serving_counter(sess, sc.SERVING_CACHE_MISSES_TOTAL)
+        sess.execute("select nextval('does_not_exist')") \
+            if False else None
+        # volatile UDF call shapes are rejected by cache_key directly
+        stmt = parse("select nextval('s1')")[0]
+        assert cache_key(stmt, (), sess.catalog, sess.settings,
+                         _UDFS) is None
+        assert _serving_counter(
+            sess, sc.SERVING_CACHE_MISSES_TOTAL) == m0
+
+    def test_view_reads_subscribe_to_base_tables(self, sess):
+        sess.execute("create view big as select k, v from kv "
+                     "where v >= 1000")
+        q = "select count(*) from big"
+        n0 = int(sess.execute(q).rows()[0][0])
+        sess.execute("update kv set v = v + 10000 where k = 5")
+        assert int(sess.execute(q).rows()[0][0]) == n0 + 1
+
+
+class TestResultCacheUnit:
+    def _mk(self, tmp_path):
+        d = str(tmp_path / "rc")
+        os.makedirs(d, exist_ok=True)
+        return d, ResultCache(d)
+
+    def _emit(self, d, lsn, table):
+        with open(os.path.join(d, "cdc_changes.jsonl"), "a") as f:
+            f.write(json.dumps({"lsn": lsn, "table": table,
+                                "kind": "insert", "shard_id": 1,
+                                "file": "x", "rows": 1}) + "\n")
+
+    def _res(self, n=3):
+        return ResultSet(["a"], {"a": np.arange(n)}, n)
+
+    def test_fill_token_refuses_mid_execution_write(self, tmp_path):
+        d, c = self._mk(tmp_path)
+        token = c.fill_token()
+        self._emit(d, 1, "t")  # a write lands while "executing"
+        assert not c.put(("k",), self._res(), ["t"], {}, token, 1 << 20)
+        # a fresh token fills fine
+        assert c.put(("k",), self._res(), ["t"], {}, c.fill_token(),
+                     1 << 20)
+
+    def test_table_indexed_invalidation(self, tmp_path):
+        d, c = self._mk(tmp_path)
+        t = c.fill_token()
+        c.put(("ka",), self._res(), ["a"], {}, t, 1 << 20)
+        c.put(("kb",), self._res(), ["b"], {}, t, 1 << 20)
+        c.put(("kab",), self._res(), ["a", "b"], {}, t, 1 << 20)
+        self._emit(d, 1, "a")
+        assert c.get(("kb",)) is not None
+        assert c.get(("ka",)) is None
+        assert c.get(("kab",)) is None
+        assert c.invalidations == 2
+
+    def test_journal_regression_drops_everything(self, tmp_path):
+        d, c = self._mk(tmp_path)
+        self._emit(d, 1, "a")
+        c.fill_token()  # consume to the tail
+        c.put(("ka",), self._res(), ["a"], {}, c.fill_token(), 1 << 20)
+        path = os.path.join(d, "cdc_changes.jsonl")
+        with open(path, "w"):
+            pass  # restore_cluster replaced the journal
+        assert c.get(("ka",)) is None
+        assert len(c) == 0
+
+
+class TestChangeFeedCursor:
+    def test_incremental_poll_and_torn_tail(self, tmp_path):
+        path = str(tmp_path / "j.jsonl")
+        cur = ChangeFeedCursor(path)
+        with open(path, "a") as f:
+            f.write(json.dumps({"lsn": 1, "table": "a"}) + "\n")
+            f.write(json.dumps({"lsn": 2, "table": "b"}) + "\n")
+        evs = cur.poll()
+        assert [e["lsn"] for e in evs] == [1, 2]
+        assert cur.poll() == []
+        with open(path, "a") as f:
+            f.write('{"lsn": 3, "tab')  # torn mid-append
+        assert cur.poll() == []  # unterminated line stays unconsumed
+        with open(path, "a") as f:
+            f.write('le": "c"}\n')
+        assert [e["lsn"] for e in cur.poll()] == [3]
+        assert cur.last_lsn == 3
+
+    def test_journal_replacement_returns_none(self, tmp_path):
+        path = str(tmp_path / "j.jsonl")
+        with open(path, "w") as f:
+            f.write(json.dumps({"lsn": 1, "table": "a"}) + "\n")
+            f.write(json.dumps({"lsn": 2, "table": "a"}) + "\n")
+        cur = ChangeFeedCursor(path)  # attaches at the tail
+        assert cur.poll() == []
+        with open(path, "w") as f:
+            f.write(json.dumps({"lsn": 1, "table": "a"}) + "\n")
+        assert cur.poll() is None  # regressed: resubscribe
+        assert cur.poll() == []
+
+
+# ---------------------------------------------------------------------------
+# FeedCache per-table index (satellite regression)
+
+
+class TestFeedCacheIndex:
+    def _feed(self, nbytes=100):
+        return CachedFeed(sharded=True, arrays={}, nulls={}, valid=None,
+                          capacity=0, nbytes=nbytes)
+
+    def test_invalidation_is_table_indexed(self):
+        fc = FeedCache(max_bytes=1 << 20)
+        fc.put(("a", 1, "x"), self._feed())
+        fc.put(("a", 2, "x"), self._feed())
+        fc.put(("b", 1, "x"), self._feed())
+        fc.invalidate_table("a", keep_version=2)
+        assert fc.get(("a", 1, "x")) is None
+        assert fc.get(("a", 2, "x")) is not None
+        assert fc.get(("b", 1, "x")) is not None
+        assert fc.invalidations == 1
+        fc.invalidate_table("b")
+        assert fc.get(("b", 1, "x")) is None
+        assert fc.invalidations == 2
+        assert fc.total_bytes == 100
+
+    def test_eviction_maintains_index(self):
+        fc = FeedCache(max_bytes=250)
+        fc.put(("a", 1, "x"), self._feed(100))
+        fc.put(("a", 1, "y"), self._feed(100))
+        fc.put(("a", 1, "z"), self._feed(100))  # evicts the oldest
+        assert len(fc) == 2 and fc.total_bytes == 200
+        fc.invalidate_table("a")  # the evicted key must not resurface
+        assert len(fc) == 0 and fc.total_bytes == 0
+
+    def test_invalidation_hammer_thread_safe(self, sess, tmp_path):
+        """Cached-plan-hammer style: point reads + repeated aggregates
+        from two sessions while a third hammers DML (every write runs
+        the indexed invalidation) — torn-free, exact answers after
+        quiescence."""
+        s2 = _second(sess, tmp_path)
+        w = _second(sess, tmp_path)
+        errors: list = []
+
+        def reader(s):
+            try:
+                for i in range(10):
+                    r = s.execute("select v from kv where k = 101")
+                    assert len(r.rows()) <= 1
+                    s.execute("select count(*), sum(v) from kv")
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        def writer():
+            try:
+                for i in range(10):
+                    w.execute(f"update kv set v = {i} where k = 101")
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        threads = [threading.Thread(target=reader, args=(s,))
+                   for s in (sess, s2) for _ in range(2)]
+        threads.append(threading.Thread(target=writer))
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        try:
+            # a straggler must fail HERE, not corrupt the quiescence
+            # asserts below with still-racing reads
+            assert not any(t.is_alive() for t in threads), \
+                "hammer thread still running after join timeout"
+            assert not errors, errors[0]
+            final = [s.execute("select v from kv where k = 101").rows()
+                     for s in (sess, s2, w)]
+            assert final[0] == final[1] == final[2] == [(9,)]
+            b = batcher_for(sess.data_dir).snapshot()
+            assert b["requests_total"] == (
+                b["answered_total"] + b["errored_total"]
+                + b["fallback_total"])
+        finally:
+            s2.close()
+            w.close()
+
+
+# ---------------------------------------------------------------------------
+# observability
+
+
+class TestObservability:
+    def test_stat_serving_columns(self, sess):
+        sess.execute("select v from kv where k = 2")
+        stat = _stat_serving(sess)
+        for col in ("requests_total", "answered_total", "errored_total",
+                    "fallback_total", "batch_dispatch_total",
+                    "batched_lookups_total", "max_batch_seen",
+                    "avg_batch_occupancy", "queue_depth",
+                    "cache_entries", "cache_bytes", "cache_hits_total",
+                    "cache_misses_total", "cache_invalidations_total",
+                    "cache_last_lsn"):
+            assert col in stat
+        assert stat["requests_total"] >= 1
+        assert stat["answered_total"] >= 1
+
+    def test_explain_analyze_serving_line(self, sess):
+        sess.execute("select v from kv where k = 8")  # fill the cache
+        r = sess.execute("explain analyze select v from kv where k = 8")
+        text = "\n".join(r.columns["QUERY PLAN"])
+        assert "Serving:" in text
+        assert "result-cache=cached" in text
+        assert "batched lookups=1" in text
+        with sess.settings.override(serving_enabled=False):
+            r = sess.execute(
+                "explain analyze select v from kv where k = 8")
+            text = "\n".join(r.columns["QUERY PLAN"])
+            assert "Serving: off" in text
+
+    def test_counters_registered_in_snapshot(self, sess):
+        snap = sess.stats.counters.snapshot()
+        for name in (sc.SERVING_BATCHED_LOOKUPS_TOTAL,
+                     sc.SERVING_BATCH_DISPATCH_TOTAL,
+                     sc.SERVING_CACHE_HITS_TOTAL,
+                     sc.SERVING_CACHE_MISSES_TOTAL,
+                     sc.SERVING_CACHE_INVALIDATIONS_TOTAL):
+            assert name in snap
+
+
+# ---------------------------------------------------------------------------
+# serving fuzz: cache-on ≡ cache-off under interleaved writes
+
+
+def _run_serving_fuzz(tmp_path, n_ops: int, seed: int) -> dict:
+    from fuzzer import generate_serving
+
+    data_dir = str(tmp_path / "srvfuzz")
+    writer = citus_tpu.connect(data_dir=data_dir, n_devices=2)
+    writer.execute("CREATE TABLE kv (id INT, v INT)")
+    writer.execute("SELECT create_distributed_table('kv', 'id', 4)")
+    writer.execute("INSERT INTO kv VALUES " + ", ".join(
+        f"({i}, {i * 3})" for i in range(60)))
+    on_s = citus_tpu.connect(data_dir=data_dir, n_devices=2)
+    off_s = citus_tpu.connect(data_dir=data_dir, n_devices=2,
+                              serving_result_cache_bytes=0)
+    rng = random.Random(seed)
+    state = {"next_id": 60}
+    stats = {"reads": 0, "writes": 0}
+    try:
+        for op in range(n_ops):
+            kind, sql, rows = generate_serving(rng, state)
+            if kind == "copy":
+                csv = str(tmp_path / f"srv_{op}.csv")
+                with open(csv, "w") as f:
+                    for i, v in rows:
+                        f.write(f"{i},{v}\n")
+                sql = f"COPY kv FROM '{csv}' WITH (FORMAT csv)"
+                kind = "write"
+            if kind == "txn_write":
+                writer.execute("BEGIN")
+                writer.execute(sql)
+                writer.execute("COMMIT")
+                stats["writes"] += 1
+                continue
+            if kind == "write":
+                writer.execute(sql)
+                stats["writes"] += 1
+                continue
+            stats["reads"] += 1
+            got = sorted(on_s.execute(sql).rows())
+            want = sorted(off_s.execute(sql).rows())
+            assert got == want, (
+                f"cache-on diverged from cache-off on {sql!r} "
+                f"(step {op}): {got} != {want}")
+        hits = on_s.stats.counters.snapshot()[
+            sc.SERVING_CACHE_HITS_TOTAL]
+        assert hits > 0, "fuzz run never hit the cache — no coverage"
+        stats["cache_hits"] = hits
+        return stats
+    finally:
+        writer.close()
+        on_s.close()
+        off_s.close()
+
+
+def test_serving_fuzz_smoke_slice(tmp_path):
+    """Deterministic tier-1 slice: repeated reads with the result cache
+    on vs off return identical rows under interleaved DML/COPY/txn
+    writes from a second session (CDC-driven invalidation, no TTLs)."""
+    stats = _run_serving_fuzz(tmp_path, n_ops=50, seed=814)
+    assert stats["reads"] >= 20 and stats["writes"] >= 5
+
+
+@pytest.mark.slow
+def test_serving_fuzz_full(tmp_path):
+    stats = _run_serving_fuzz(tmp_path, n_ops=350, seed=20260803)
+    assert stats["reads"] >= 150 and stats["writes"] >= 50
