@@ -227,6 +227,160 @@ def bench_concurrency() -> None:
         shutil.rmtree(data_dir, ignore_errors=True)
 
 
+def bench_memory_pressure() -> None:
+    """`python bench.py memory_pressure` — graceful-degradation A/B
+    under device-memory starvation (PERF_NOTES round 12): N worker
+    sessions over one data_dir run a mixed join/agg statement stream
+    while the shared device-memory accountant (executor/hbm.py) is
+    armed with a MemSim budget deliberately sized BELOW the workload's
+    rehearsed peak, in two modes:
+
+      * `memory_pressure_completed_share_ungoverned` — oom_degradation
+        OFF: every allocator OOM surfaces immediately as a clean
+        ResourceExhausted (the pre-PR-10 behavior minus the dead
+        process);
+      * `memory_pressure_completed_share_governed` — the degradation
+        ladder ON: evict → shrink → stream → multi-pass before giving
+        up.
+
+    Each line reports the completed-statement share, the OOM-error
+    rate, ladder counters (oom events / evictions / spill passes) and
+    aggregate rows/s, so the artifact records BOTH what the ladder
+    saves and what it costs.  Knobs: BENCH_MEM_WORKERS (default 8),
+    BENCH_MEM_ITERS (statements per worker, default 6),
+    BENCH_MEM_BUDGET_SHARE (budget as a fraction of rehearsed peak,
+    default 0.5), BENCH_SF (default 0.05)."""
+    import threading
+
+    from citus_tpu.executor.hbm import accountant_for, oom_budget
+    from citus_tpu.errors import ResourceExhausted
+    from citus_tpu.ingest.tpch import load_into_session
+    from citus_tpu.session import Session
+    from citus_tpu.stats import counters as mem_sc
+
+    n_workers = int(os.environ.get("BENCH_MEM_WORKERS", "8"))
+    n_iters = int(os.environ.get("BENCH_MEM_ITERS", "6"))
+    share = float(os.environ.get("BENCH_MEM_BUDGET_SHARE", "0.5"))
+    sf = float(os.environ.get("BENCH_SF", "0.05"))
+    data_dir = tempfile.mkdtemp(prefix="citus_tpu_mem_")
+    try:
+        seed_sess = Session(data_dir=data_dir,
+                            serving_result_cache_bytes=0)
+        counts = load_into_session(seed_sess, sf=sf, seed=0,
+                                   tables={"orders", "lineitem"})
+        n_li, n_ord = counts["lineitem"], counts["orders"]
+        mix = [
+            ("select l_returnflag, count(*), sum(l_quantity) "
+             "from lineitem group by l_returnflag", n_li),
+            ("select count(*), sum(l_extendedprice) from orders, "
+             "lineitem where o_orderkey = l_orderkey", n_ord + n_li),
+            ("select count(*) from orders, lineitem "
+             "where o_custkey = l_suppkey", n_ord + n_li),
+        ]
+        acc = accountant_for(data_dir)
+        # rehearsal: un-failing MemSim records the workload's peak live
+        # bytes; the armed budget is a deliberate fraction of it
+        for sql, _ in mix:
+            seed_sess.execute(sql)
+        peak0 = acc.peak_bytes
+        with oom_budget(acc):
+            seed_sess.executor.feed_cache.clear()
+            for sql, _ in mix:
+                seed_sess.execute(sql)
+        budget = max(1, int(max(acc.peak_bytes, peak0) * share))
+        seed_sess.close()
+
+        def run_mode(governed: bool):
+            # BOTH arms run with the WLM HBM gate aligned to the armed
+            # budget (planned-estimate + measured-pressure admission,
+            # oversized statements admit solo, streaming engages by
+            # sizing) — the A/B isolates the LADDER: what happens when
+            # an allocation still fails anyway
+            sessions = [Session(
+                data_dir=data_dir, serving_result_cache_bytes=0,
+                oom_degradation=governed,
+                max_feed_bytes_per_device=budget,
+                retry_backoff_base_ms=1, retry_backoff_max_ms=5)
+                for _ in range(n_workers)]
+            for s in sessions:  # warm plan caches off the clock
+                for sql, _ in mix:
+                    s.execute(sql)
+                s.executor.feed_cache.clear()
+            tallies = {"completed": 0, "oom_errors": 0, "other": 0}
+            tlock = threading.Lock()
+            rows_done = [0] * n_workers
+            snap0 = [s.stats.counters.snapshot() for s in sessions]
+
+            def worker(i, s):
+                local = {"completed": 0, "oom_errors": 0, "other": 0}
+                for _ in range(n_iters):
+                    for sql, rows in mix:
+                        try:
+                            s.execute(sql)
+                            local["completed"] += 1
+                            rows_done[i] += rows
+                        except ResourceExhausted:
+                            local["oom_errors"] += 1
+                        except Exception:
+                            local["other"] += 1
+                with tlock:
+                    for k, v in local.items():
+                        tallies[k] += v
+
+            threads = [threading.Thread(target=worker, args=(i, s))
+                       for i, s in enumerate(sessions)]
+            t0 = time.perf_counter()
+            with oom_budget(acc, budget=budget):
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+            elapsed = time.perf_counter() - t0
+
+            def counter_delta(name):
+                return sum(
+                    s.stats.counters.snapshot().get(name, 0)
+                    - snap0[i].get(name, 0)
+                    for i, s in enumerate(sessions))
+
+            oom_events = counter_delta(mem_sc.OOM_EVENTS_TOTAL)
+            evictions = counter_delta(mem_sc.CACHE_EVICTIONS_TOTAL)
+            spills = counter_delta(mem_sc.SPILL_PASSES_TOTAL)
+            shrinks = counter_delta(
+                mem_sc.STREAM_BATCH_SHRINKS_TOTAL)
+            for s in sessions:
+                s.close()
+            total = n_workers * n_iters * len(mix)
+            return {
+                "metric": "memory_pressure_completed_share_"
+                          + ("governed" if governed else "ungoverned"),
+                "value": round(tallies["completed"] / total, 4),
+                "unit": "share",
+                "seconds": round(elapsed, 4),
+                "sf": sf,
+                "workers": n_workers,
+                "iters": n_iters,
+                "statements": total,
+                "budget_bytes": budget,
+                "budget_share_of_peak": share,
+                "completed": tallies["completed"],
+                "oom_errors": tallies["oom_errors"],
+                "other_errors": tallies["other"],
+                "oom_error_share": round(
+                    tallies["oom_errors"] / total, 4),
+                "oom_events": oom_events,
+                "cache_evictions": evictions,
+                "stream_batch_shrinks": shrinks,
+                "spill_passes": spills,
+                "rows_per_sec": round(sum(rows_done) / elapsed, 1),
+            }
+
+        for governed in (False, True):
+            print(json.dumps(run_mode(governed)), flush=True)
+    finally:
+        shutil.rmtree(data_dir, ignore_errors=True)
+
+
 def bench_serving() -> None:
     """`python bench.py serving` — high-QPS point-lookup A/B for the
     serving layer (PERF_NOTES round 11): N concurrent sessions over one
@@ -372,6 +526,9 @@ def main() -> None:
         return
     if sys.argv[1:2] == ["serving"]:
         bench_serving()
+        return
+    if sys.argv[1:2] == ["memory_pressure"]:
+        bench_memory_pressure()
         return
     sf = float(os.environ.get("BENCH_SF", "1.0"))
     repeats = int(os.environ.get("BENCH_REPEATS", "3"))
@@ -616,6 +773,13 @@ def main() -> None:
         if (only is None or "point_lookup_qps" in only) \
                 and not over_budget(0.85):
             bench_serving()
+
+        # -- memory-pressure scenario (PR 10): the governed/ungoverned
+        #    A/B lands in the driver artifact so the README/PERF_NOTES
+        #    degradation claims stay honesty-checkable ----------------
+        if (only is None or "memory_pressure" in only) \
+                and not over_budget(0.9):
+            bench_memory_pressure()
 
         # headline LAST (driver contract: final JSON line)
         if only is None or "tpch_q1_rows_per_sec" in only:

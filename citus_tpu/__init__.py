@@ -22,11 +22,13 @@ from .errors import (
     CitusTpuError,
     ConfigError,
     CorruptStripe,
+    DeviceMemoryExhausted,
     ExecutionError,
     IngestError,
     ParseError,
     PlanningError,
     QueryCanceled,
+    ResourceExhausted,
     StatementTimeout,
     StorageError,
     TransactionError,
@@ -53,7 +55,7 @@ __all__ = [
     "UnsupportedQueryError",
     "ExecutionError", "CapacityOverflowError", "IngestError",
     "TransactionError", "QueryCanceled", "StatementTimeout",
-    "AdmissionRejected",
+    "AdmissionRejected", "ResourceExhausted", "DeviceMemoryExhausted",
     "__version__",
 ]
 

@@ -22,7 +22,9 @@ Four static rule families over the ``citus_tpu/`` + ``tools/`` tree:
 * **error/resource discipline** (`discipline.py`) — bare ``except:``,
   swallowed ``BaseException``, broad handlers that swallow fault-point
   seams, raw lock ``.acquire()`` outside context managers, threads
-  started without join/daemon ownership.
+  started without join/daemon ownership, durable writes outside the
+  ``utils/io`` seam, and device placements outside the
+  ``executor/hbm`` accounted seam.
 
 Findings are suppressed either inline (``# graftlint: ignore[rule]``)
 or via the repo-root ``lint_baseline.json`` where every entry carries a

@@ -140,7 +140,8 @@ FAMILY_RULES = {
                              "config-registry", "explain-tag-registry"}),
     "discipline": frozenset({"bare-except", "swallowed-base-exception",
                              "swallowed-fault-seam", "silent-exception",
-                             "unowned-thread", "raw-durable-write"}),
+                             "unowned-thread", "raw-durable-write",
+                             "raw-device-placement"}),
 }
 
 

@@ -27,6 +27,16 @@
   harness's interception point.  Genuinely non-durable writes (build
   artifacts, lint baselines) justify themselves inline or in the
   baseline.
+* ``raw-device-placement`` — ``jax.device_put`` / ``put_sharded`` /
+  ``put_replicated`` anywhere in ``citus_tpu/`` outside the
+  ``executor/hbm`` accounted-placement seam (and the ``distributed/
+  mesh`` primitives it drives): a placement that bypasses
+  ``DeviceMemoryAccountant.place`` is invisible to the measured HBM
+  ledger, the OOM classification that feeds the degradation ladder,
+  AND the MemSim torture harness's interception point — the
+  raw-durable-write pattern applied to device memory.  Genuinely
+  unaccounted placements (single-scalar health probes) justify
+  themselves inline.
 """
 
 from __future__ import annotations
@@ -40,6 +50,11 @@ _BROAD = ("Exception", "BaseException")
 # the sanctioned home of raw durable-write primitives: the shared
 # helper seam itself, plus the crash shim that simulates torn disks
 _IO_SEAM = ("citus_tpu/utils/io.py", "citus_tpu/utils/crashsim.py")
+
+# the sanctioned home of raw device-placement primitives: the
+# accounted seam itself, plus the mesh helpers it drives
+_PLACEMENT_SEAM = ("citus_tpu/executor/hbm.py",
+                   "citus_tpu/distributed/mesh.py")
 
 
 def _is_write_mode(node: ast.Call) -> bool:
@@ -138,6 +153,7 @@ class _Visitor(ast.NodeVisitor):
     def visit_Call(self, node: ast.Call) -> None:
         fn = node.func
         self._check_raw_durable_write(node, fn)
+        self._check_raw_device_placement(node, fn)
         is_thread_ctor = (
             isinstance(fn, ast.Attribute) and fn.attr == "Thread"
             and isinstance(fn.value, ast.Name)
@@ -176,6 +192,29 @@ class _Visitor(ast.NodeVisitor):
                        "open() for writing outside utils/io — durable "
                        "state must go through the atomic-write seam; "
                        "justify genuinely non-durable writers inline")
+
+    def _check_raw_device_placement(self, node: ast.Call, fn) -> None:
+        if not self.mod.relpath.startswith("citus_tpu/") or \
+                self.mod.relpath in _PLACEMENT_SEAM:
+            return
+        name = (fn.id if isinstance(fn, ast.Name)
+                else fn.attr if isinstance(fn, ast.Attribute)
+                else None)
+        if name in ("put_sharded", "put_replicated"):
+            self._flag("raw-device-placement", node,
+                       f"{name}() outside executor/hbm — route the "
+                       "placement through DeviceMemoryAccountant."
+                       "place() so the measured HBM ledger, OOM "
+                       "classification and the MemSim torture harness "
+                       "all apply")
+            return
+        if name == "device_put" and isinstance(fn, ast.Attribute) and \
+                isinstance(fn.value, ast.Name) and fn.value.id == "jax":
+            self._flag("raw-device-placement", node,
+                       "jax.device_put() outside executor/hbm — "
+                       "device placement must flow through the "
+                       "accounted seam; justify genuinely unaccounted "
+                       "placements inline")
 
     def _joined_nearby(self) -> bool:
         """The enclosing function (or class, for threads stored on self

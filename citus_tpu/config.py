@@ -162,10 +162,41 @@ _register(ConfigVar(
     int, min_value=0, max_value=1 << 30))
 _register(ConfigVar(
     "max_plan_buffer_bytes", 32 << 30,
-    "Reject plans whose largest static device buffer would exceed this "
-    "(cartesian/extreme-fanout protection: a clean error instead of an "
-    "allocator OOM). 0 disables the guard.",
+    "Ceiling on a plan's largest static device buffer. Plans over it "
+    "whose shape the OOM degradation ladder can help (streamable / "
+    "multi-pass-splittable) degrade instead of erroring; genuinely "
+    "ineligible shapes (windows, cartesian blowups) keep the clean "
+    "immediate reject. 0 disables the guard.",
     int, min_value=0, max_value=1 << 44))
+
+# --- device-memory governance (executor/hbm.py accountant + the OOM
+# degradation ladder) -------------------------------------------------------
+_register(ConfigVar(
+    "hbm_budget_bytes", 0,
+    "Explicit per-device HBM byte budget the accountant enforces the "
+    "capacity-regrow guard against (executor/hbm.py). 0 = derive from "
+    "an armed MemSim budget or the backend's reported bytes_limit "
+    "where available; no enforcement when neither exists. No direct "
+    "reference GUC — the analogue is the work_mem family bounding "
+    "per-node memory.",
+    int, min_value=0, max_value=1 << 44))
+_register(ConfigVar(
+    "oom_degradation", True,
+    "Route DeviceMemoryExhausted (allocator RESOURCE_EXHAUSTED) "
+    "through the degradation ladder — evict caches, shrink stream "
+    "batches, force streaming, multi-pass partitioned execution — "
+    "retrying after each rung (executor.Executor.degrade_for_oom). "
+    "Off surfaces the first OOM as a clean ResourceExhausted "
+    "immediately (the bench memory_pressure A/B's ungoverned arm).",
+    bool))
+_register(ConfigVar(
+    "oom_max_spill_passes", 16,
+    "Ceiling on multi-pass partitioned execution's pass count "
+    "(executor/multipass.py); the ladder surfaces a clean "
+    "ResourceExhausted rather than splitting further. Grace-style "
+    "partition counts beyond ~16 mean the statement is hopeless at "
+    "this memory size anyway.",
+    int, min_value=2, max_value=4096))
 
 # --- resilience -----------------------------------------------------------
 _register(ConfigVar(

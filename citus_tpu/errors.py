@@ -96,6 +96,27 @@ class ExecutionError(CitusTpuError):
     """Runtime failure during distributed execution."""
 
 
+class ResourceExhausted(ExecutionError):
+    """Device memory could not be made to fit even after the OOM
+    degradation ladder (cache eviction → stream-batch shrink → forced
+    streaming → multi-pass partitioned execution) ran out of rungs —
+    the clean, client-facing terminal error.  The analogue of the
+    reference failing a query with 53200 out_of_memory after the
+    executor exhausted its options; never a dead process, never wrong
+    rows."""
+
+
+class DeviceMemoryExhausted(ResourceExhausted):
+    """An HBM allocation failed (XLA RESOURCE_EXHAUSTED, or the
+    accountant's armed MemSim budget/fault injection).  Raised at the
+    device-placement seam (executor/hbm.py) and classified by the
+    session retry envelope as *retryable-after-degradation*: each
+    retry first applies the next rung of the degradation ladder
+    (executor.Executor.degrade_for_oom) so the re-run needs less
+    device memory.  Subclasses ResourceExhausted so an unhandled
+    escape is still a clean framework error."""
+
+
 class CapacityOverflowError(ExecutionError):
     """A static-capacity device buffer overflowed (join/shuffle output).
 

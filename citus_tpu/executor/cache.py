@@ -246,6 +246,24 @@ class FeedCache:
                 self._pop_locked(k)
             self.invalidations += len(stale)
 
+    def evict_coldest(self, target_bytes: int | None = None) -> int:
+        """Evict entries in LRU (coldest-first) order until
+        `target_bytes` have been freed — everything when None.  The OOM
+        degradation ladder's first rung (executor.Executor.
+        degrade_for_oom): the arrays' device memory is reclaimed as
+        soon as no in-flight statement still references them.  Returns
+        entries evicted."""
+        with self._lock:
+            evicted = 0
+            freed = 0
+            while self._entries and (target_bytes is None
+                                     or freed < target_bytes):
+                key = next(iter(self._entries))
+                freed += self._entries[key].nbytes
+                self._pop_locked(key)
+                evicted += 1
+            return evicted
+
     def clear(self) -> None:
         with self._lock:
             self._entries.clear()

@@ -30,7 +30,6 @@ from ..planner.plan import (
     WindowNode,
 )
 from ..storage import TableStore
-from ..distributed.mesh import put_replicated, put_sharded
 from .compiler import FeedSpec, _round_cap
 
 
@@ -45,13 +44,19 @@ def walk_plan(node: PlanNode):
 
 def build_feeds(plan: QueryPlan, catalog: Catalog, store: TableStore,
                 mesh: Mesh, compute_dtype=np.float32,
-                cache=None, counters=None) -> dict[int, FeedSpec]:
+                cache=None, counters=None, accountant=None,
+                no_cache_nodes=frozenset()) -> dict[int, FeedSpec]:
+    """`no_cache_nodes`: node ids whose feeds bypass the device cache —
+    the multipass driver's per-pass split feeds must NOT pin every
+    pass's partition resident at once (that would defeat the pass)."""
     feeds: dict[int, FeedSpec] = {}
     for node in walk_plan(plan.root):
         if isinstance(node, ScanNode):
+            node_cache = None if id(node) in no_cache_nodes else cache
             feeds[id(node)] = _feed_scan_cached(node, catalog, store, mesh,
                                                 plan.n_devices, compute_dtype,
-                                                cache, counters)
+                                                node_cache, counters,
+                                                accountant)
     return feeds
 
 
@@ -148,7 +153,7 @@ def _overlay_touches(store: TableStore, table: str) -> bool:
 
 def _feed_scan_cached(node: ScanNode, catalog: Catalog, store: TableStore,
                       mesh: Mesh, n_dev: int, compute_dtype,
-                      cache, counters=None) -> FeedSpec:
+                      cache, counters=None, accountant=None) -> FeedSpec:
     """Device-feed cache wrapper: HBM-resident table arrays keyed on
     (table, columns, pruning, placement, data version) — see
     executor/cache.py.  Open-transaction overlays bypass the cache (their
@@ -156,7 +161,7 @@ def _feed_scan_cached(node: ScanNode, catalog: Catalog, store: TableStore,
     table = node.rel.table
     if cache is None or _overlay_touches(store, table):
         return _feed_scan(node, catalog, store, mesh, n_dev, compute_dtype,
-                          counters)
+                          counters, accountant, category="feed")
     shards = catalog.table_shards(table)
     placement_sig = tuple(
         (s.shard_id, catalog.active_placement(s.shard_id).node_id)
@@ -170,8 +175,11 @@ def _feed_scan_cached(node: ScanNode, catalog: Catalog, store: TableStore,
         # superseded versions of this table can never hit again — free
         # their HBM before resident-caching the fresh feed
         cache.invalidate_table(table, keep_version=key[1])
+        # accounted as "cache" from the start: the arrays become
+        # cache-resident below, and cache bytes are the evictable
+        # class the ladder/admission pressure treats as reclaimable
         spec = _feed_scan(node, catalog, store, mesh, n_dev, compute_dtype,
-                          counters)
+                          counters, accountant, category="cache")
         from .cache import CachedFeed
 
         nbytes = sum(int(np.dtype(a.dtype).itemsize * a.size)
@@ -189,7 +197,8 @@ def _feed_scan_cached(node: ScanNode, catalog: Catalog, store: TableStore,
 
 def _feed_scan(node: ScanNode, catalog: Catalog, store: TableStore,
                mesh: Mesh, n_dev: int, compute_dtype,
-               counters=None) -> FeedSpec:
+               counters=None, accountant=None,
+               category: str = "feed") -> FeedSpec:
     rel = node.rel
     meta = catalog.table(rel.table)
     colnames = [cid.split(".", 1)[1] for cid in node.columns]
@@ -272,15 +281,21 @@ def _feed_scan(node: ScanNode, catalog: Catalog, store: TableStore,
         feed = FeedSpec(node=node, sharded=False, arrays=arrays, nulls=nulls,
                         valid=valid, capacity=cap)
 
-    # place on the mesh
+    # place on the mesh through the ONE accounted seam (executor/hbm.py)
     from ..utils.faultinjection import fault_point
+    from .hbm import accountant_for
 
     # named seam: a host→HBM transfer failure (device OOM, remote-
     # attached link drop) must surface as a retryable statement error,
     # never a partially placed feed
     fault_point("executor.device_put")
-    put = put_sharded if feed.sharded else put_replicated
-    feed.arrays = {c: put(mesh, a) for c, a in feed.arrays.items()}
-    feed.nulls = {c: put(mesh, a) for c, a in feed.nulls.items()}
-    feed.valid = put(mesh, feed.valid)
+    acc = accountant_for(store.data_dir) if accountant is None \
+        else accountant
+
+    def put(a):
+        return acc.place(mesh, a, feed.sharded, category)
+
+    feed.arrays = {c: put(a) for c, a in feed.arrays.items()}
+    feed.nulls = {c: put(a) for c, a in feed.nulls.items()}
+    feed.valid = put(feed.valid)
     return feed

@@ -1,0 +1,368 @@
+"""Device-memory accountant: the ONE host→HBM placement seam.
+
+The canonical accelerator failure mode — the HBM allocator returning
+RESOURCE_EXHAUSTED — used to be fatal here: the error was unclassified
+by the retry machinery and nothing tracked how much device memory was
+actually live.  This module makes device bytes a *governed resource*
+the way locks (transaction/locks.py) and admission slots (wlm/) are:
+
+* **DeviceMemoryAccountant** — ONE per data_dir (sessions sharing a
+  data_dir share the device), a measured ledger of live per-device
+  bytes.  Every placement in the tree flows through :meth:`place`
+  (graftlint's ``raw-device-placement`` rule rejects bypasses), which
+  charges the ledger, intercepts allocator RESOURCE_EXHAUSTED and
+  re-raises it as the classified :class:`DeviceMemoryExhausted`, and
+  hangs a ``weakref.finalize`` off the returned array so the charge is
+  released the moment the device buffer is garbage — the ledger is
+  *measured* live bytes, not an estimate.  Static plan intermediates
+  (join/shuffle/grid buffers, which XLA allocates inside the compiled
+  program where Python cannot see them) charge through :meth:`lease`
+  for the duration of each execution, using the same worst-buffer
+  estimate the ``max_plan_buffer_bytes`` guard trusts.
+
+* **MemSim** — the CrashSim pattern at this seam: an armed per-device
+  byte budget (and/or a deterministic fail-at-allocation-N trigger)
+  raises synthetic RESOURCE_EXHAUSTED so the OOM torture harness
+  (tests/test_oom_torture.py) can sweep every allocation index of a
+  workload on hardware that never really OOMs.  Releases credit the
+  simulated allocator too, so the degradation ladder's evictions
+  genuinely create headroom under an armed budget.
+
+Charge categories:
+
+* ``feed``        — transient resident-path table feeds (statement-scoped)
+* ``cache``       — feed-cache-resident arrays (evictable on demand: the
+                    OOM ladder's first rung frees them, so they do not
+                    count against admission pressure)
+* ``stream``      — in-flight stream/multipass batch arrays
+* ``plan``        — leased static plan-buffer estimate of an executing
+                    program
+* ``other``       — anything else routed through the seam
+
+`jax` ``device.memory_stats()`` is cross-checked where the backend
+exposes it (TPU does; CPU test meshes return None) and surfaced via
+``citus_stat_memory()``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import weakref
+
+from ..errors import DeviceMemoryExhausted
+
+CATEGORIES = ("feed", "cache", "stream", "plan", "other")
+
+# substring the XLA allocator (and MemSim, deliberately) puts in every
+# device-OOM message — the classification key
+_OOM_TOKEN = "RESOURCE_EXHAUSTED"
+
+
+def is_resource_exhausted(exc: BaseException) -> bool:
+    """Does this exception report a device-allocator OOM?  Matches the
+    XLA RESOURCE_EXHAUSTED status string (jaxlib raises it as
+    XlaRuntimeError with the status name embedded in the message)."""
+    return _OOM_TOKEN in str(exc)
+
+
+class MemSim:
+    """One simulated HBM lifetime: arm with ``budget`` (per-device
+    bytes; a charge that would exceed it OOMs) and/or ``fail_at=N``
+    (the N-th charge through the seam OOMs once, 1-based).  Journals
+    every charge so the torture harness can size its sweep."""
+
+    def __init__(self, budget: int | None = None,
+                 fail_at: int | None = None):
+        self.budget = budget
+        self.fail_at = fail_at
+        self.allocs = 0
+        self.oom_raised = 0
+        self.journal: list[tuple[int, str, int]] = []
+
+
+class DeviceMemoryAccountant:
+    """Measured live device bytes for one data_dir's mesh (per-device
+    accounting: sharded arrays divide across devices, replicated ones
+    occupy their full size on every device)."""
+
+    def __init__(self, data_dir: str):
+        self.data_dir = data_dir
+        # REENTRANT: _release runs from weakref finalizers, which the
+        # interpreter may fire at ANY allocation point — including gc
+        # triggered inside a _charge that already holds the lock.  A
+        # plain Lock would self-deadlock there; with an RLock the
+        # nested _release interleaves safely (it touches only its own
+        # handle's entry)
+        self._mu = threading.RLock()
+        self._next_handle = 0
+        # handle → (category, per-device bytes)
+        self._live: dict[int, tuple[str, int]] = {}
+        self._live_total = 0
+        self._live_by_cat: dict[str, int] = {c: 0 for c in CATEGORIES}
+        self.peak_bytes = 0
+        self.charges_total = 0
+        self.releases_total = 0
+        self.oom_total = 0
+        self._sim: MemSim | None = None
+        self._backend_budget: int | None = None  # memoized bytes_limit
+        # weak registry of evictable device caches (each session's
+        # FeedCache): the device is shared, so the OOM ladder's
+        # eviction rung must be able to reclaim EVERY session's
+        # cache-resident bytes, not just the OOMing session's own
+        self._evictables: list = []
+
+    # -- the seam ----------------------------------------------------------
+    def place(self, mesh, arr, sharded: bool, category: str = "feed"):
+        """Place one host array on the mesh through the accounted seam.
+        Returns the device array; raises DeviceMemoryExhausted when the
+        allocator (real or simulated) refuses."""
+        from ..distributed.mesh import put_replicated, put_sharded
+        from ..utils.faultinjection import fault_point
+
+        # named seam: a host→HBM transfer that dies here (device OOM,
+        # remote-attached link drop) must surface as a classified
+        # statement error, never a partially placed feed
+        fault_point("executor.hbm_exhausted")
+        n_dev = mesh.devices.size
+        nbytes = (int(arr.nbytes) if not sharded or n_dev <= 0
+                  else -(-int(arr.nbytes) // n_dev))
+        handle = self._charge(category, nbytes)
+        try:
+            out = (put_sharded if sharded else put_replicated)(mesh, arr)
+        except Exception as e:
+            self._release(handle)
+            if is_resource_exhausted(e):
+                self._count_oom()
+                err = DeviceMemoryExhausted(
+                    f"device allocator OOM placing {nbytes} bytes/device "
+                    f"(category {category!r}): {e}")
+                err.nbytes = nbytes  # bounds the eviction rung's target
+                raise err from e
+            raise
+        weakref.finalize(out, self._release, handle)
+        return out
+
+    @contextlib.contextmanager
+    def lease(self, category: str, nbytes: int):
+        """Charge `nbytes`/device for the duration of the block — the
+        static-plan-buffer accounting around each compiled execution
+        (XLA allocates those inside the program; the lease makes them
+        visible to the ledger, the WLM gate and MemSim)."""
+        handle = self._charge(category, max(0, int(nbytes)))
+        try:
+            yield
+        finally:
+            self._release(handle)
+
+    # -- ledger ------------------------------------------------------------
+    def _charge(self, category: str, nbytes: int) -> int:
+        if category not in CATEGORIES:
+            category = "other"
+        with self._mu:
+            sim = self._sim
+            if sim is not None:
+                sim.allocs += 1
+                sim.journal.append((sim.allocs, category, nbytes))
+                fail = (sim.fail_at is not None
+                        and sim.allocs == sim.fail_at)
+                over = (sim.budget is not None
+                        and self._live_total + nbytes > sim.budget)
+                if fail or over:
+                    sim.oom_raised += 1
+                    self.oom_total += 1
+                    why = (f"armed at allocation {sim.fail_at}" if fail
+                           else f"budget {sim.budget} bytes/device, "
+                                f"{self._live_total} live")
+                    err = DeviceMemoryExhausted(
+                        f"{_OOM_TOKEN} (MemSim): allocation "
+                        f"{sim.allocs} of {nbytes} bytes/device "
+                        f"(category {category!r}) refused — {why}")
+                    err.nbytes = nbytes
+                    raise err
+            self._next_handle += 1
+            handle = self._next_handle
+            self._live[handle] = (category, nbytes)
+            self._live_total += nbytes
+            self._live_by_cat[category] += nbytes
+            self.charges_total += 1
+            if self._live_total > self.peak_bytes:
+                self.peak_bytes = self._live_total
+            return handle
+
+    def _release(self, handle: int) -> None:
+        with self._mu:
+            entry = self._live.pop(handle, None)
+            if entry is None:
+                return
+            category, nbytes = entry
+            self._live_total -= nbytes
+            self._live_by_cat[category] -= nbytes
+            self.releases_total += 1
+
+    def _count_oom(self) -> None:
+        with self._mu:
+            self.oom_total += 1
+
+    def note_oom(self) -> None:
+        """Fold an allocator OOM observed OUTSIDE place()/lease() (a
+        compiled program's internal allocation) into the totals."""
+        self._count_oom()
+
+    # -- reads -------------------------------------------------------------
+    def live_bytes(self, category: str | None = None) -> int:
+        with self._mu:
+            return (self._live_total if category is None
+                    else self._live_by_cat.get(category, 0))
+
+    def transient_bytes(self) -> int:
+        """Live bytes that should return to zero between statements —
+        everything but the deliberately resident feed cache.  The OOM
+        torture harness asserts this is 0 after every statement (no
+        accountant leaks)."""
+        with self._mu:
+            return self._live_total - self._live_by_cat["cache"]
+
+    def pressure_bytes(self) -> int:
+        """Live bytes that genuinely constrain a new admission: cache
+        bytes are excluded because they are reclaimable on demand (the
+        degradation ladder's first rung evicts them)."""
+        return self.transient_bytes()
+
+    def budget_bytes(self, settings=None) -> int:
+        """The per-device byte ceiling the accountant can enforce
+        against: an armed MemSim budget, else the `hbm_budget_bytes`
+        config var, else the backend's reported bytes_limit where
+        available.  0 = unknown/unbounded."""
+        with self._mu:
+            if self._sim is not None and self._sim.budget is not None:
+                return self._sim.budget
+        if settings is not None:
+            cfg = settings.get("hbm_budget_bytes")
+            if cfg:
+                return int(cfg)
+        if self._backend_budget is None:
+            # computed once: device limits are fixed for the process,
+            # and memory_stats() can be a backend RPC
+            stats = self.device_memory_stats()
+            limits = [s.get("bytes_limit", 0) for s in stats]
+            self._backend_budget = (min(limits)
+                                    if limits and all(limits) else 0)
+        return self._backend_budget
+
+    @staticmethod
+    def device_memory_stats() -> list[dict]:
+        """Per-device allocator stats where the backend exposes them
+        (TPU/GPU do; CPU returns None) — the measured cross-check the
+        ledger is validated against in citus_stat_memory()."""
+        import jax
+
+        out = []
+        for d in jax.devices():
+            try:
+                stats = d.memory_stats()
+            except Exception:
+                stats = None
+            if stats:
+                out.append({"device": str(d),
+                            "bytes_in_use": int(
+                                stats.get("bytes_in_use", 0)),
+                            "peak_bytes_in_use": int(
+                                stats.get("peak_bytes_in_use", 0)),
+                            "bytes_limit": int(
+                                stats.get("bytes_limit", 0))})
+        return out
+
+    def snapshot(self) -> dict:
+        """citus_stat_memory() source."""
+        with self._mu:
+            by_cat = dict(self._live_by_cat)
+            sim = self._sim
+            snap = {
+                "live_bytes": self._live_total,
+                "peak_bytes": self.peak_bytes,
+                "charges_total": self.charges_total,
+                "releases_total": self.releases_total,
+                "oom_total": self.oom_total,
+                "memsim_armed": sim is not None,
+                "memsim_budget": (sim.budget if sim is not None
+                                  else None),
+                "memsim_allocs": sim.allocs if sim is not None else 0,
+            }
+        for c in CATEGORIES:
+            snap[f"live_{c}_bytes"] = by_cat[c]
+        return snap
+
+    # -- eviction registry -------------------------------------------------
+    def register_evictable(self, cache) -> None:
+        """Register a cache exposing evict_coldest(target_bytes) —
+        called once per Executor for its FeedCache; weakly held so a
+        closed session's cache does not pin."""
+        with self._mu:
+            self._evictables = [r for r in self._evictables
+                                if r() is not None]
+            self._evictables.append(weakref.ref(cache))
+
+    def evict_evictable(self, target_bytes: int | None = None) -> int:
+        """Evict cache-resident device arrays across EVERY registered
+        cache, coldest-first within each, until `target_bytes` have
+        been requested freed (None = everything).  Returns entries
+        evicted.  Runs outside the accountant lock: evicting acquires
+        each cache's own lock, and the dropped arrays' finalizers
+        re-enter _release (lock order: cache lock → accountant lock,
+        never the reverse)."""
+        with self._mu:
+            refs = list(self._evictables)
+        evicted = 0
+        remaining = target_bytes
+        for ref in refs:
+            cache = ref()
+            if cache is None:
+                continue
+            before = cache.total_bytes
+            evicted += cache.evict_coldest(remaining)
+            if remaining is not None:
+                remaining -= max(0, before - cache.total_bytes)
+                if remaining <= 0:
+                    break
+        return evicted
+
+    # -- simulation --------------------------------------------------------
+    def install_sim(self, sim: MemSim | None) -> None:
+        with self._mu:
+            self._sim = sim
+
+
+# process-wide registry: sessions sharing a data_dir share the device,
+# so they share ONE ledger (the lock-manager/WLM pattern)
+_registry: dict[str, DeviceMemoryAccountant] = {}
+_registry_mu = threading.Lock()
+
+
+def accountant_for(data_dir: str) -> DeviceMemoryAccountant:
+    key = os.path.realpath(data_dir)
+    with _registry_mu:
+        if key not in _registry:
+            _registry[key] = DeviceMemoryAccountant(key)
+        return _registry[key]
+
+
+class oom_budget:
+    """``with oom_budget(accountant, budget=..., fail_at=...) as sim:``
+    — arm a MemSim for the duration of the block.  ``budget=None,
+    fail_at=None`` counts allocations without failing (the rehearsal
+    run that sizes the torture sweep)."""
+
+    def __init__(self, accountant: DeviceMemoryAccountant,
+                 budget: int | None = None, fail_at: int | None = None):
+        self.accountant = accountant
+        self.sim = MemSim(budget, fail_at)
+
+    def __enter__(self) -> MemSim:
+        self.accountant.install_sim(self.sim)
+        return self.sim
+
+    def __exit__(self, *exc) -> bool:
+        self.accountant.install_sim(None)
+        return False

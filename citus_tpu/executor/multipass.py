@@ -1,0 +1,255 @@
+"""Multi-pass partitioned execution: the Grace-hash move for a
+too-big NON-stream side.
+
+The stream pipeline (executor/stream.py) bounds the residency of ONE
+scan — the probe side — but a join whose *build* side alone exceeds
+device memory still cannot run.  The classic answer is Grace hash
+join: partition the build input, run one pass per partition, merge.
+We already own every piece — hash shards ARE disjoint partitions of
+the build table, the feed path honors `pruned_shards`, and the stream
+driver's distributive merge recombines per-pass partials — so a pass
+here is simply the ordinary executor run with the split scan pruned
+to one shard group:
+
+* pick the LARGEST eligible hash-distributed scan (the split node);
+* divide its (unpruned) shards into K balanced groups;
+* run the plan K times, each pass with the split scan pruned to one
+  group — each pass may itself stream its probe side, so the two
+  larger-than-memory mechanisms compose;
+* merge: a mergeable aggregate root re-aggregates across passes
+  (count/sum/min/max are distributive — the same coordinator combine
+  the stream path uses), plain row outputs concatenate.
+
+Eligibility is stricter than streaming: every join between the split
+scan and the root must be INNER (disjoint build partitions ⇒ each
+output row materializes in exactly one pass; outer/semi/anti joins
+would emit unmatched-or-matched decisions per pass that are only
+correct globally), aggregates only at the root and distributive,
+windows never.
+
+The driver is a rung of the OOM degradation ladder
+(executor.Executor.degrade_for_oom): it runs only after eviction,
+batch shrink and forced streaming all failed to fit the statement.
+"""
+
+from __future__ import annotations
+
+import copy
+
+import numpy as np
+
+from ..catalog import DistributionMethod
+from ..planner.plan import (
+    AggregateNode,
+    JoinNode,
+    PlanNode,
+    ProjectNode,
+    QueryPlan,
+    ScanNode,
+    WindowNode,
+)
+from .feed import walk_plan
+from .stream import (
+    _mergeable_aggregate,
+    _scale_path_estimates,
+    _scan_dev_rows,
+    _scan_width_bytes,
+    merge_aggregate_parts,
+)
+
+
+def _multipass_path(plan: QueryPlan, split_id: int) -> bool:
+    """Is pruning the scan `split_id` to disjoint shard groups and
+    unioning the per-pass outputs semantics-preserving?  (See module
+    docstring for the rules.)"""
+
+    def path_to(node: PlanNode) -> list[PlanNode] | None:
+        if id(node) == split_id:
+            return [node]
+        kids = []
+        if isinstance(node, JoinNode):
+            kids = [node.left, node.right]
+        elif isinstance(node, (AggregateNode, ProjectNode, WindowNode)):
+            kids = [node.input]
+        for k in kids:
+            p = path_to(k)
+            if p is not None:
+                return [node] + p
+        return None
+
+    path = path_to(plan.root)
+    if path is None:
+        return False
+    for i, node in enumerate(path[:-1]):
+        if isinstance(node, JoinNode):
+            if node.join_type != "inner" or not node.left_keys:
+                return False
+        elif isinstance(node, WindowNode):
+            return False
+        elif isinstance(node, AggregateNode):
+            if i != 0 or not _mergeable_aggregate(node):
+                return False
+    return True
+
+
+def _effective_shards(node: ScanNode, catalog) -> list[int]:
+    """Shard indices the scan would actually read (existing pruning
+    applied)."""
+    shards = catalog.table_shards(node.rel.table)
+    return [s.shard_index for s in shards
+            if node.pruned_shards is None
+            or s.shard_index in node.pruned_shards]
+
+
+def multipass_candidate(plan: QueryPlan, catalog, store, n_dev: int,
+                        compute_dtype,
+                        prefer_not: int | None = None) -> ScanNode | None:
+    """The largest hash-distributed scan whose path admits disjoint
+    partition passes and that has ≥2 shards to split; None when the
+    plan has no useful split.
+
+    `prefer_not` (a node id): when the stream pipeline already bounds
+    one scan's residency (the forced-stream rung ran before this one),
+    splitting that SAME scan buys nothing — the pressure left is the
+    OTHER side's feeds and the repartition/join buffers sized off
+    them.  Prefer a different split when one is eligible; fall back to
+    the largest overall."""
+    best, best_bytes = None, -1
+    alt, alt_bytes = None, -1
+    for s in walk_plan(plan.root):
+        if not isinstance(s, ScanNode):
+            continue
+        if catalog.table(s.rel.table).method != DistributionMethod.HASH:
+            continue
+        if len(_effective_shards(s, catalog)) < 2:
+            continue
+        if not _multipass_path(plan, id(s)):
+            continue
+        nbytes = _scan_dev_rows(s, catalog, store, n_dev) * \
+            _scan_width_bytes(s, catalog, compute_dtype)
+        if nbytes > best_bytes:
+            best, best_bytes = s, nbytes
+        if id(s) != prefer_not and nbytes > alt_bytes:
+            alt, alt_bytes = s, nbytes
+    return alt if alt is not None else best
+
+
+def _shard_groups(node: ScanNode, catalog, store, k: int) -> list[list[int]]:
+    """Split the scan's effective shards into ≤k balanced groups
+    (greedy largest-first into the lightest group)."""
+    table = node.rel.table
+    shards = {s.shard_index: s.shard_id
+              for s in catalog.table_shards(table)}
+    eff = _effective_shards(node, catalog)
+    k = min(k, len(eff))
+    sized = sorted(((store.shard_row_count(table, shards[i]), i)
+                    for i in eff), reverse=True)
+    groups: list[list[int]] = [[] for _ in range(k)]
+    loads = [0] * k
+    for rows, idx in sized:
+        g = loads.index(min(loads))
+        groups[g].append(idx)
+        loads[g] += rows
+    return [g for g in groups if g]
+
+
+def try_execute_multipass(executor, plan: QueryPlan, raw: bool, k: int):
+    """K host-resident passes over disjoint shard groups of the split
+    scan; None ⇒ caller proceeds on the stream/resident path."""
+    if k <= 1:
+        return None
+    compute_dtype = np.dtype(executor.settings.get("compute_dtype"))
+    prefer_not = None
+    if executor.oom.force_stream:
+        # the stream rung already bounds the largest stream-eligible
+        # scan — split the OTHER side when one is eligible
+        from .stream import stream_candidates
+
+        cands = stream_candidates(plan, executor.catalog)
+        if cands:
+            sizes = {id(s): _scan_dev_rows(s, executor.catalog,
+                                           executor.store,
+                                           plan.n_devices)
+                     * _scan_width_bytes(s, executor.catalog,
+                                         compute_dtype)
+                     for s in cands}
+            prefer_not = max(sizes, key=sizes.get)
+    split = multipass_candidate(plan, executor.catalog, executor.store,
+                                plan.n_devices, compute_dtype,
+                                prefer_not=prefer_not)
+    if split is None:
+        return None
+    groups = _shard_groups(split, executor.catalog, executor.store, k)
+    if len(groups) < 2:
+        return None
+    split_widx = next(i for i, n in enumerate(walk_plan(plan.root))
+                      if n is split)
+    n_eff = sum(len(g) for g in groups)
+
+    parts: list = []
+    rows_scanned = 0
+    retries_total = 0
+    batches_total = 0
+    from ..utils.cancellation import check_cancel
+
+    for group in groups:
+        # pass boundaries are cancellation seams, like stream batches
+        check_cancel()
+        p = copy.deepcopy(plan)
+        node = next(n for i, n in enumerate(walk_plan(p.root))
+                    if i == split_widx)
+        node.pruned_shards = sorted(group)
+        # downstream buffers size per pass, not per table
+        _scale_path_estimates(p, id(node), len(group) / max(1, n_eff))
+        pass_parts, scanned, retries, batches = \
+            executor.execute_pass(p, id(node))
+        parts.extend(pass_parts)
+        rows_scanned += scanned
+        retries_total += retries
+        batches_total += batches
+    if executor.counters is not None:
+        from ..stats import counters as sc
+
+        executor.counters.increment(sc.SPILL_PASSES_TOTAL, len(groups))
+
+    agg_root = (plan.root if isinstance(plan.root, AggregateNode)
+                else None)
+    if agg_root is not None:
+        merged_c, merged_n = merge_aggregate_parts(agg_root, parts)
+    else:
+        merged_c = {cid: np.concatenate([p[0][cid] for p in parts])
+                    for cid in parts[0][0]} if parts else {}
+        merged_n = {cid: np.concatenate([p[1][cid] for p in parts])
+                    for cid in parts[0][1]} if parts else {}
+    n = len(next(iter(merged_c.values()))) if merged_c else 0
+    valid = np.ones((1, n), dtype=bool)
+    cols = {cid: a.reshape(1, n) for cid, a in merged_c.items()}
+    nulls = {cid: a.reshape(1, n) for cid, a in merged_n.items()}
+    result = executor._host_combine(plan, cols, nulls, valid, raw)
+    # pass concatenation destroys device-major row order — a raw
+    # consumer (INSERT..SELECT) must re-route host-side
+    result.device_rows = None
+    result.retries = retries_total
+    result.device_rows_scanned = rows_scanned
+    result.streamed_batches = batches_total
+    result.spill_passes = len(groups)
+    return result
+
+
+def ladder_degradable(plan: QueryPlan, catalog, store, n_dev: int,
+                      compute_dtype) -> bool:
+    """Can ANY rung of the degradation ladder reduce this plan's device
+    footprint?  Windows and keyless (cartesian) joins anywhere in the
+    tree are the genuinely ineligible shapes — for those the
+    max_plan_buffer_bytes guard keeps its clean immediate reject."""
+    from .stream import stream_candidates
+
+    for n in walk_plan(plan.root):
+        if isinstance(n, WindowNode):
+            return False
+        if isinstance(n, JoinNode) and not n.left_keys:
+            return False
+    if stream_candidates(plan, catalog):
+        return True
+    return multipass_candidate(plan, catalog, store, n_dev,
+                               compute_dtype) is not None

@@ -18,7 +18,12 @@ from jax.sharding import Mesh
 
 from ..catalog import Catalog
 from ..config import Settings
-from ..errors import CapacityOverflowError, ExecutionError, PlanningError
+from ..errors import (
+    CapacityOverflowError,
+    DeviceMemoryExhausted,
+    ExecutionError,
+    PlanningError,
+)
 from ..planner import expr as ir
 from ..planner.plan import (
     AggregateNode,
@@ -50,6 +55,28 @@ from .feed import build_feeds, walk_plan
 
 MAX_RETRIES = 4
 
+# degradation ladder bounds: each batch-shrink rung halves the stream
+# batch (one memoized recompile per level); beyond this the rung is
+# spent and the ladder moves on
+MAX_BATCH_SHRINK = 64
+
+
+@dataclass
+class OomState:
+    """Sticky (per-executor) outcome of the OOM degradation ladder —
+    memoized so a statement that needed rungs does not re-discover
+    them (and re-pay the OOM + recompile) on every execution.
+
+    * ``batch_shrink`` — divisor applied to the stream batch_cap;
+    * ``force_stream`` — stream even when the feeds fit the configured
+      budget (a real OOM proved the effective ceiling lower);
+    * ``multipass_k`` — split the build side into K host-resident
+      passes (executor/multipass.py)."""
+
+    batch_shrink: int = 1
+    force_stream: bool = False
+    multipass_k: int = 1
+
 
 @dataclass
 class ResultSet:
@@ -65,6 +92,7 @@ class ResultSet:
     device_rows_scanned: int = 0
     fast_path: bool = False   # executed host-side via the fast-path router
     streamed_batches: int = 0  # >0 ⇒ executed via the stream pipeline
+    spill_passes: int = 0     # >0 ⇒ executed via multi-pass partitioning
     # per-column NULL masks (raw mode keeps typed arrays + mask instead of
     # objectified None entries); None when columns carry None directly
     null_masks: dict[str, np.ndarray] | None = None
@@ -114,6 +142,18 @@ class Executor:
         # dict is iterated while being written (_memoize_caps), which
         # CPython turns into "dict changed size during iteration"
         self._caps_lock = threading.Lock()
+        # device-memory accountant: ONE per data_dir (sessions share
+        # the device) — every placement this executor makes flows
+        # through it, and the OOM degradation ladder consults its
+        # measured ledger (executor/hbm.py)
+        from .hbm import accountant_for
+
+        self.accountant = accountant_for(store.data_dir)
+        self.accountant.register_evictable(self.feed_cache)
+        self.oom = OomState()
+        # per-thread plan of the in-flight statement: the degradation
+        # ladder peeks at it to skip rungs that cannot help this shape
+        self._oom_tls = threading.local()
 
     # ------------------------------------------------------------------
     def execute_plan(self, plan: QueryPlan, raw: bool = False) -> ResultSet:
@@ -127,18 +167,47 @@ class Executor:
             if isinstance(node, ScanNode):
                 self.store.refresh_if_stale(node.rel.table)
 
+        # the degradation ladder peeks at the in-flight plan to decide
+        # which rungs can help this statement's shape
+        self._oom_tls.plan = plan
         fast = try_execute_fast_path(self, plan, raw)
         if fast is not None:
             return fast
+        if self.oom.multipass_k > 1:
+            from .multipass import try_execute_multipass
+
+            mp = try_execute_multipass(self, plan, raw,
+                                       self.oom.multipass_k)
+            if mp is not None:
+                return mp
         from .stream import try_execute_streamed
 
         streamed = try_execute_streamed(self, plan, raw)
         if streamed is not None:
             return streamed
         compute_dtype = np.dtype(self.settings.get("compute_dtype"))
+        packed, out_meta, caps, retries = self._run_resident(
+            plan, compute_dtype)
+        self.count_groupby_bucketed(plan, caps)
+        cols, nulls, valid = unpack_outputs(packed, out_meta)
+        result = self._host_combine(plan, cols, nulls, valid, raw)
+        result.retries = retries
+        # result-transfer volume in row slots (n_dev·cap, or n_dev·k under
+        # device top-k pushdown) — EXPLAIN ANALYZE / stats surface this
+        result.device_rows_scanned = int(np.asarray(valid).size)
+        return result
+
+    # ------------------------------------------------------------------
+    def _run_resident(self, plan: QueryPlan, compute_dtype,
+                      no_cache_nodes=frozenset()):
+        """Resident-feed execution core: build feeds, resolve the
+        capacity memo, run the overflow-retry loop.  Shared by
+        execute_plan and the multipass pass driver."""
         feeds = build_feeds(plan, self.catalog, self.store, self.mesh,
                             compute_dtype, cache=self.feed_cache,
-                            counters=self.counters)
+                            counters=self.counters,
+                            accountant=self.accountant,
+                            no_cache_nodes=no_cache_nodes)
         # device_topk + its ORDER BY keys are traced into the program
         topk_sig = (plan.device_topk, tuple(
             (repr(e), d, nf) for e, d, nf in plan.host_order_by)
@@ -159,16 +228,35 @@ class Executor:
             memo = self._caps_memo.get(fingerprint)
         caps = (self._caps_from_order(plan, memo) if memo is not None
                 else self._initial_capacities(plan, feeds))
-        packed, out_meta, caps, retries = self.run_with_retry(
-            plan, feeds, caps, fingerprint, compute_dtype)
+        return self.run_with_retry(plan, feeds, caps, fingerprint,
+                                   compute_dtype)
+
+    # ------------------------------------------------------------------
+    def execute_pass(self, plan: QueryPlan, split_nid: int):
+        """One multipass pass (executor/multipass.py): run the pruned
+        plan via the stream pipeline when it still exceeds the budget,
+        else resident, and return its flattened pre-combine parts as
+        (parts, rows_scanned, retries, streamed_batches).  The split
+        scan's per-pass feed bypasses the device cache — resident-
+        caching every pass's partition would defeat the pass."""
+        from .stream import _flatten_batch, try_execute_streamed
+
+        streamed = try_execute_streamed(self, plan, raw=True,
+                                        return_parts=True,
+                                        no_cache_nodes=frozenset(
+                                            {split_nid}))
+        if streamed is not None:
+            parts, scanned, retries, batches, caps = streamed
+            if caps is not None:
+                self.count_groupby_bucketed(plan, caps)
+            return parts, scanned, retries, batches
+        compute_dtype = np.dtype(self.settings.get("compute_dtype"))
+        packed, out_meta, caps, retries = self._run_resident(
+            plan, compute_dtype, no_cache_nodes=frozenset({split_nid}))
         self.count_groupby_bucketed(plan, caps)
         cols, nulls, valid = unpack_outputs(packed, out_meta)
-        result = self._host_combine(plan, cols, nulls, valid, raw)
-        result.retries = retries
-        # result-transfer volume in row slots (n_dev·cap, or n_dev·k under
-        # device top-k pushdown) — EXPLAIN ANALYZE / stats surface this
-        result.device_rows_scanned = int(np.asarray(valid).size)
-        return result
+        scanned = int(np.asarray(valid).size)
+        return [_flatten_batch(cols, nulls, valid)], scanned, retries, 0
 
     # ------------------------------------------------------------------
     def run_with_retry(self, plan: QueryPlan, feeds, caps: Capacities,
@@ -199,6 +287,16 @@ class Executor:
             if limit:
                 est = _plan_buffer_bytes(plan, caps)
                 if est > limit:
+                    if self._plan_degradable(plan):
+                        # eligible over-limit plans route into the OOM
+                        # degradation ladder (stream / multi-pass)
+                        # instead of erroring — the guard becomes a
+                        # pre-allocation OOM signal
+                        raise DeviceMemoryExhausted(
+                            f"RESOURCE_EXHAUSTED (guard): plan needs "
+                            f"~{est / 1e9:.1f} GB of device buffers "
+                            f"(max_plan_buffer_bytes = "
+                            f"{limit / 1e9:.1f} GB) — degrading")
                     raise PlanningError(
                         f"plan needs ~{est / 1e9:.1f} GB of device "
                         f"buffers (max_plan_buffer_bytes = "
@@ -233,16 +331,35 @@ class Executor:
             # trip on remote-attached TPUs)
             import jax
 
+            from .hbm import is_resource_exhausted
+
+            # XLA allocates the program's static intermediates where
+            # Python cannot see them — the lease makes the estimate
+            # visible to the measured ledger (and to an armed MemSim)
+            # for exactly the execution window
+            est_per_dev = _plan_buffer_bytes(plan, caps) \
+                // max(1, plan.n_devices)
             try:
-                packed, overflow = jax.device_get(fn(*feed_arrays))
+                with self.accountant.lease("plan", est_per_dev):
+                    packed, overflow = jax.device_get(fn(*feed_arrays))
             except jax.errors.JaxRuntimeError as e:
+                if is_resource_exhausted(e):
+                    # the canonical accelerator failure: classify it so
+                    # the session retry envelope degrades-then-retries
+                    # instead of dying (errors.DeviceMemoryExhausted)
+                    self.accountant.note_oom()
+                    raise DeviceMemoryExhausted(
+                        f"device allocator OOM executing plan "
+                        f"(~{est_per_dev} intermediate bytes/device): "
+                        f"{e}") from e
                 # remote-attached compile services flake transiently on
                 # long compilations (connection drops mid-response); one
                 # clean retry re-issues the compile.  Anything else, or a
                 # second failure, propagates.
                 if "remote_compile" not in str(e):
                     raise
-                packed, overflow = jax.device_get(fn(*feed_arrays))
+                with self.accountant.lease("plan", est_per_dev):
+                    packed, overflow = jax.device_get(fn(*feed_arrays))
             ov = np.asarray(overflow).reshape(-1, 2 + len(stage_keys))
             cap_overflow = int(ov[:, 0].sum())
             dense_oob = int(ov[:, 1].sum())
@@ -307,6 +424,125 @@ class Executor:
                                 for k, v in fresh.agg_bucket.items()})
             if cap_overflow:
                 caps = caps.grown(cap_overflow)
+            # overflow-regrow bounded by the accountant: a regrow whose
+            # buffers can no longer fit the remaining device budget
+            # would retry straight into a guaranteed OOM — degrade
+            # (stream / multi-pass) instead of burning the retries
+            budget = self.accountant.budget_bytes(self.settings)
+            if budget:
+                need = _plan_buffer_bytes(plan, caps) \
+                    // max(1, plan.n_devices)
+                room = budget - self.accountant.pressure_bytes()
+                if need > room and self._plan_degradable(plan):
+                    raise DeviceMemoryExhausted(
+                        f"RESOURCE_EXHAUSTED (regrow guard): capacity "
+                        f"regrow needs ~{need} bytes/device but only "
+                        f"~{room} remain of the {budget}-byte device "
+                        "budget — degrading instead of retrying into "
+                        "a guaranteed OOM")
+
+    # ------------------------------------------------------------------
+    def _plan_degradable(self, plan: QueryPlan) -> bool:
+        """Can the degradation ladder shrink this plan's footprint?
+        (executor/multipass.py owns the shape rules; windows and
+        cartesian blowups stay clean immediate rejects.)"""
+        from .multipass import ladder_degradable
+
+        return ladder_degradable(
+            plan, self.catalog, self.store, plan.n_devices,
+            np.dtype(self.settings.get("compute_dtype")))
+
+    # ------------------------------------------------------------------
+    def degrade_for_oom(self, step: int, nbytes: int | None = None
+                        ) -> str | None:
+        """Apply the next rung of the OOM degradation ladder; returns
+        the rung name, or None when no rung can help (the session then
+        surfaces a clean ResourceExhausted).  `step` is the statement's
+        1-based OOM count — monotone, so repeated OOMs walk DOWN the
+        ladder instead of cycling on one rung; `nbytes` is the failed
+        allocation's size when known (bounds the eviction target).
+
+        Rungs, cheapest first:
+          1. evict feed/result caches coldest-first (free HBM, nothing
+             recompiles);
+          2. halve the stream batch_cap (one memoized recompile);
+          3. force the stream path even under the resident ceiling;
+          4+. multi-pass partitioned execution, K doubling per rung.
+        EVERY rung re-runs the eviction first — a retry re-fills the
+        device cache, and stale cached feeds riding into a shrunk/
+        streamed re-run would eat exactly the headroom the rung just
+        created.  Batch-shrink/force/multipass state is sticky on the
+        executor — memoized, so later statements start from the
+        converged shape."""
+        evicted = self._evict_for_oom(nbytes)
+        if step <= 1:
+            if evicted:
+                return "evict_caches"
+            step = 2  # nothing to evict: spend the escalation rung now
+        plan = getattr(self._oom_tls, "plan", None)
+        can_stream = False
+        can_multipass = False
+        if plan is not None:
+            from .multipass import multipass_candidate
+            from .stream import stream_candidates
+
+            can_stream = bool(stream_candidates(plan, self.catalog))
+            can_multipass = multipass_candidate(
+                plan, self.catalog, self.store, plan.n_devices,
+                np.dtype(self.settings.get("compute_dtype"))) is not None
+        max_passes = self.settings.get("oom_max_spill_passes")
+        i = step - 2  # escalation ladder position (0-based)
+        while True:
+            if i == 0:
+                if can_stream and self.oom.batch_shrink < MAX_BATCH_SHRINK:
+                    self.oom.batch_shrink *= 2
+                    if self.counters is not None:
+                        from ..stats import counters as sc
+
+                        self.counters.increment(
+                            sc.STREAM_BATCH_SHRINKS_TOTAL)
+                    return "shrink_stream_batch"
+            elif i == 1:
+                if can_stream and not self.oom.force_stream:
+                    self.oom.force_stream = True
+                    return "force_stream"
+            else:
+                if can_multipass and self.oom.multipass_k < max_passes:
+                    self.oom.multipass_k = min(
+                        max_passes, max(2, self.oom.multipass_k * 2))
+                    return "multipass"
+                return None
+            i += 1
+
+    def _evict_for_oom(self, nbytes: int | None = None) -> int:
+        """Rung 1: drop cache-resident device arrays coldest-first —
+        across EVERY session's FeedCache on this data_dir (the device
+        is shared; another session's cache pins HBM just the same).
+        Frees at least 4× the failed allocation when its size is known
+        (headroom for the retry's sibling feeds), everything
+        otherwise.  Returns DEVICE cache entries evicted — only those
+        mark the rung successful (a retry is pointless unless HBM was
+        actually freed)."""
+        # err.nbytes is PER-DEVICE; CachedFeed.nbytes (what eviction
+        # counts down) is the host array total across all devices —
+        # scale the target or sharded feeds under-evict by n_devices
+        n_dev = max(1, self.mesh.devices.size)
+        target = nbytes * 4 * n_dev if nbytes else None
+        evicted = self.accountant.evict_evictable(target)
+        if evicted and self.counters is not None:
+            from ..stats import counters as sc
+
+            self.counters.increment(sc.CACHE_EVICTIONS_TOTAL, evicted)
+        # best-effort: finished result sets are host bytes, but a
+        # memory-pressured data_dir should not keep serving caches
+        # warm either; never resurrects a released registry entry and
+        # never counts toward the rung's success
+        from ..serving.result_cache import peek_result_cache
+
+        rcache = peek_result_cache(self.store.data_dir)
+        if rcache is not None and len(rcache):
+            rcache.clear()
+        return evicted
 
     # ------------------------------------------------------------------
     def count_groupby_bucketed(self, plan: QueryPlan,

@@ -43,7 +43,6 @@ from ..planner.plan import (
     table_placement,
 )
 from ..catalog import DistributionMethod
-from ..distributed.mesh import put_sharded
 from .cache import feeds_signature, node_fingerprint
 from .compiler import FeedSpec, _round_cap, unpack_outputs
 from .feed import _feed_scan_cached, walk_plan
@@ -143,13 +142,30 @@ def _mergeable_aggregate(node: AggregateNode) -> bool:
     return True
 
 
+def stream_candidates(plan: QueryPlan, catalog) -> list[ScanNode]:
+    """Hash-distributed scans on a semantics-preserving stream path —
+    the eligibility half of pick_stream_node, shared with the OOM
+    degradation ladder (can a forced-stream rung help this plan?)."""
+    return [s for s in walk_plan(plan.root) if isinstance(s, ScanNode)
+            and catalog.table(s.rel.table).method ==
+            DistributionMethod.HASH and _stream_path(plan, id(s))]
+
+
 def pick_stream_node(plan: QueryPlan, catalog, store, n_dev: int,
-                     compute_dtype, budget: int, forced_rows: int = 0):
+                     compute_dtype, budget: int, forced_rows: int = 0,
+                     shrink: int = 1, force: bool = False):
     """(stream ScanNode, batch_cap) or None.
 
     Streams only when the combined per-device feed bytes exceed `budget`
     and the largest sharded scan is on a semantics-preserving path.  A
-    non-zero `forced_rows` (test/tuning knob) overrides batch sizing."""
+    non-zero `forced_rows` (test/tuning knob) overrides batch sizing.
+
+    `shrink`/`force` are the OOM degradation ladder's inputs
+    (executor.Executor.degrade_for_oom): `shrink` divides the computed
+    batch_cap (each level is one recompile, memoized via the plan
+    fingerprint), `force` streams even when the feeds fit the
+    configured budget — a real allocator OOM proved the effective
+    ceiling lower than the configured one."""
     scans = [n for n in walk_plan(plan.root) if isinstance(n, ScanNode)]
     sizes = {}
     for s in scans:
@@ -157,7 +173,7 @@ def pick_stream_node(plan: QueryPlan, catalog, store, n_dev: int,
         sizes[id(s)] = _round_cap(max(rows, 1)) * \
             _scan_width_bytes(s, catalog, compute_dtype)
     total = sum(sizes.values())
-    if total <= budget:
+    if total <= budget and not force:
         return None
     candidates = [s for s in scans
                   if catalog.table(s.rel.table).method ==
@@ -166,16 +182,27 @@ def pick_stream_node(plan: QueryPlan, catalog, store, n_dev: int,
         return None
     stream = max(candidates, key=lambda s: sizes[id(s)])
     width = _scan_width_bytes(stream, catalog, compute_dtype)
+    stream_rows = max(1, sizes[id(stream)] // width)
     if forced_rows:
-        return stream, _round_cap(forced_rows)
+        return stream, _round_cap(max(1, forced_rows // max(1, shrink)))
     other = total - sizes[id(stream)]
     # double-buffering + downstream join/shuffle intermediates sized off
     # the batch: budget the stream batch at 1/6 of what remains
     avail = budget - other
-    if avail < 6 * width * 4096:
+    if avail < 6 * width * 4096 and not force:
         return None  # other feeds leave no useful room — fall through
-    batch_cap = _round_cap(int(avail // (6 * width)))
-    if batch_cap * 1.05 >= sizes[id(stream)] // width:
+    batch_cap = int(max(avail, 6 * width * 1024) // (6 * width))
+    if force:
+        # a forced stream must actually batch: at least 2 batches even
+        # when the sizing math says everything fits — and the usual
+        # 1024-row floor must not re-inflate a small table's halved
+        # cap back into one full-table batch (128 is the _round_cap
+        # floor; shrink may push small tables' batches below 1024 by
+        # design — that is exactly what the rung is for)
+        batch_cap = min(batch_cap, -(-stream_rows // 2))
+    floor = 128 if force else 1024
+    batch_cap = _round_cap(max(floor, batch_cap // max(1, shrink)))
+    if not force and batch_cap * 1.05 >= stream_rows:
         return None  # would be a single batch anyway
     return stream, batch_cap
 
@@ -188,7 +215,9 @@ class StreamBatcher:
     feed batches, reading lazily (at most one open stripe per device)."""
 
     def __init__(self, node: ScanNode, catalog, store, mesh, n_dev: int,
-                 compute_dtype, batch_cap: int):
+                 compute_dtype, batch_cap: int, accountant=None):
+        from .hbm import accountant_for
+
         self.node = node
         self.catalog = catalog
         self.store = store
@@ -196,6 +225,8 @@ class StreamBatcher:
         self.n_dev = n_dev
         self.compute_dtype = compute_dtype
         self.batch_cap = batch_cap
+        self.accountant = (accountant_for(store.data_dir)
+                           if accountant is None else accountant)
         table = node.rel.table
         shards = catalog.table_shards(table)
         placement = table_placement(catalog, table, n_dev)
@@ -314,11 +345,17 @@ class StreamBatcher:
             valid[d, :per_dev[d][1]] = True
         feed = FeedSpec(node=node, sharded=True, arrays=arrays,
                         nulls=nulls, valid=valid, capacity=cap)
-        feed.arrays = {c: put_sharded(self.mesh, a)
-                       for c, a in feed.arrays.items()}
-        feed.nulls = {c: put_sharded(self.mesh, a)
-                      for c, a in feed.nulls.items()}
-        feed.valid = put_sharded(self.mesh, feed.valid)
+        # accounted placement (executor/hbm.py): a batch that does not
+        # fit raises the classified DeviceMemoryExhausted through the
+        # consumer queue, and its charge releases with the batch arrays
+        acc = self.accountant
+
+        def put(a):
+            return acc.place(self.mesh, a, True, "stream")
+
+        feed.arrays = {c: put(a) for c, a in feed.arrays.items()}
+        feed.nulls = {c: put(a) for c, a in feed.nulls.items()}
+        feed.valid = put(feed.valid)
         return feed
 
 
@@ -406,18 +443,35 @@ def merge_aggregate_parts(node: AggregateNode, parts):
 # ---------------------------------------------------------------------------
 # driver
 
-def try_execute_streamed(executor, plan: QueryPlan, raw: bool):
+def try_execute_streamed(executor, plan: QueryPlan, raw: bool,
+                         return_parts: bool = False,
+                         no_cache_nodes=frozenset()):
     """Streamed execution when the plan's feeds exceed the HBM budget;
-    None ⇒ caller proceeds on the resident-feed path."""
+    None ⇒ caller proceeds on the resident-feed path.
+
+    `return_parts=True` (the multipass driver's mode) skips the final
+    host combine and returns (parts, rows_scanned, retries, batches,
+    caps) — flattened per-batch column/null dicts the caller merges
+    across its own passes before ONE host combine."""
     settings = executor.settings
     budget = settings.get("max_feed_bytes_per_device")
     if budget <= 0:
         return None
+    # the accountant may know a REAL ceiling below the configured one
+    # (armed MemSim, hbm_budget_bytes, backend bytes_limit): size the
+    # stream against it so the statement streams at the true budget
+    # up front instead of discovering it through an OOM round-trip
+    hw = executor.accountant.budget_bytes(settings)
+    if hw:
+        budget = min(budget, hw)
     compute_dtype = np.dtype(settings.get("compute_dtype"))
     n_dev = plan.n_devices
+    oom = executor.oom
     picked = pick_stream_node(plan, executor.catalog, executor.store,
                               n_dev, compute_dtype, budget,
-                              settings.get("stream_batch_rows"))
+                              settings.get("stream_batch_rows"),
+                              shrink=oom.batch_shrink,
+                              force=oom.force_stream)
     if picked is None:
         return None
     stream_node, batch_cap = picked
@@ -431,14 +485,17 @@ def try_execute_streamed(executor, plan: QueryPlan, raw: bool):
     _scale_path_estimates(plan, id(stream_node), frac)
 
     batcher = StreamBatcher(stream_node, executor.catalog, executor.store,
-                            executor.mesh, n_dev, compute_dtype, batch_cap)
+                            executor.mesh, n_dev, compute_dtype, batch_cap,
+                            accountant=executor.accountant)
     feeds: dict[int, FeedSpec] = {}
     for node in walk_plan(plan.root):
         if isinstance(node, ScanNode) and node is not stream_node:
+            cache = (None if id(node) in no_cache_nodes
+                     else executor.feed_cache)
             feeds[id(node)] = _feed_scan_cached(
                 node, executor.catalog, executor.store, executor.mesh,
-                n_dev, compute_dtype, executor.feed_cache,
-                executor.counters)
+                n_dev, compute_dtype, cache,
+                executor.counters, executor.accountant)
 
     # prefetch thread: builds + device_puts the next batch while the mesh
     # chews the current one.  stop_evt lets a failing consumer unblock
@@ -541,6 +598,8 @@ def try_execute_streamed(executor, plan: QueryPlan, raw: bool):
                 break
         t.join(timeout=5.0)
 
+    if return_parts:
+        return parts, rows_scanned, retries_total, n_consumed, caps
     if agg_root is not None:
         merged_c, merged_n = merge_aggregate_parts(agg_root, parts)
     else:

@@ -45,6 +45,7 @@ EXPLAIN_TAGS: dict[str, str] = {
     "Chunks Skipped": "chunk groups pruned by min/max skip nodes",
     "Streamed Execution": "scan ran via the batched stream pipeline",
     "Device Rows Scanned": "result-transfer volume in row slots",
+    "Memory": "device-memory ledger + OOM degradation for this statement",
     "Resilience": "retry/failover totals for this statement",
     "Integrity": "stripes CRC-verified / read-repaired this statement",
     "Caches": "plan/feed cache traffic for this statement",

@@ -349,6 +349,16 @@ _refs: dict[str, int] = {}
 _registry_mu = threading.Lock()
 
 
+def peek_result_cache(data_dir: str) -> "ResultCache | None":
+    """The registry's existing cache for `data_dir`, or None — WITHOUT
+    creating one.  For best-effort consumers (the OOM ladder's
+    eviction rung) that must not resurrect an entry the refcounted
+    acquire/release lifecycle already dropped."""
+    key = os.path.realpath(data_dir)
+    with _registry_mu:
+        return _registry.get(key)
+
+
 def result_cache_for(data_dir: str) -> ResultCache:
     key = os.path.realpath(data_dir)
     with _registry_mu:

@@ -48,7 +48,8 @@ _UDFS = ("create_distributed_table", "create_reference_table",
          "citus_stat_counters", "citus_stat_counters_reset",
          "citus_stat_statements", "citus_stat_statements_reset",
          "citus_stat_tenants", "citus_stat_activity", "citus_stat_wlm",
-         "citus_stat_serving", "get_rebalance_progress",
+         "citus_stat_serving", "citus_stat_memory",
+         "get_rebalance_progress",
          "citus_split_shard_by_split_points", "isolate_tenant_to_node",
          "citus_cleanup_orphaned_resources",
          "citus_rebalance_start", "citus_rebalance_wait",
@@ -350,7 +351,8 @@ class Session:
             tenant=tenant,
             priority=self.settings.get("wlm_default_priority"),
             feed_bytes=planned_feed_bytes(target, self.catalog,
-                                          self.store, self.n_devices),
+                                          self.store, self.n_devices,
+                                          self.settings),
             weight=weights.get(tenant, 1),
             max_slots=self.settings.get("max_concurrent_statements"),
             max_feed_bytes=self.settings.get("max_feed_bytes_per_device"),
@@ -431,7 +433,12 @@ class Session:
         import random as _random
         import time as _time
 
-        from .errors import QueryCanceled, StatementTimeout
+        from .errors import (
+            DeviceMemoryExhausted,
+            QueryCanceled,
+            ResourceExhausted,
+            StatementTimeout,
+        )
         from .stats import counters as sc
         from .utils.cancellation import check_cancel, deadline_scope
 
@@ -439,6 +446,7 @@ class Session:
         if timeout_ms is None:
             timeout_ms = self.settings.get("statement_timeout_ms")
         attempt = 0
+        oom_steps = 0  # statement-local position on the OOM ladder
         with deadline_scope(timeout_ms or None,
                             self._cancel_evt) as deadline:
             while True:
@@ -470,6 +478,34 @@ class Session:
                     if getattr(e, "injected_fault", False):
                         self.stats.counters.increment(
                             sc.FAULTS_INJECTED_TOTAL)
+                    # device-memory exhaustion is *retryable-after-
+                    # degradation*: each OOM applies the next rung of
+                    # the ladder (evict caches → shrink stream batches
+                    # → force streaming → multi-pass), then re-runs —
+                    # ending in a clean ResourceExhausted when no rung
+                    # can help, never a dead process or wrong rows.
+                    # Degradation retries ride their own counter, not
+                    # max_statement_retries: the ladder's depth is a
+                    # property of the shape, not a transient-fault
+                    # budget.  A write's device SELECT half runs before
+                    # any visibility flip, so the re-run is safe.
+                    if isinstance(e, DeviceMemoryExhausted) and \
+                            commit_txid is None:
+                        self.stats.counters.increment(
+                            sc.OOM_EVENTS_TOTAL)
+                        if not self.settings.get("oom_degradation"):
+                            raise
+                        oom_steps += 1
+                        rung = self.executor.degrade_for_oom(
+                            oom_steps, getattr(e, "nbytes", None))
+                        if rung is None:
+                            raise ResourceExhausted(
+                                "statement does not fit device memory "
+                                f"even after {oom_steps - 1} "
+                                f"degradation rung(s): {e}") from e
+                        if activity is not None:
+                            activity.retries = attempt + oom_steps
+                        continue  # re-run degraded (deadline intact)
                     retryable = self._retryable_error(e)
                     # COPY commits each parsed batch independently, so
                     # re-executing a partially ingested file would
@@ -965,11 +1001,17 @@ class Session:
                     return 0
                 return max(0, live[i] - a.cache_base[i])
 
+            # live/peak device bytes are the data_dir-shared accountant's
+            # measured ledger at snapshot time (sessions share the
+            # device, so the columns repeat per row like slots_total)
+            hbm_live = self.executor.accountant.live_bytes()
+            hbm_peak = self.executor.accountant.peak_bytes
             return ResultSet(
                 ["global_pid", "query", "state", "wait_state",
                  "queued_ms", "retries", "read_repairs",
                  "plan_cache_hits", "plan_cache_misses",
-                 "feed_cache_hits", "feed_cache_misses"],
+                 "feed_cache_hits", "feed_cache_misses",
+                 "hbm_live_bytes", "hbm_peak_bytes"],
                 {"global_pid": [a.gpid for a in entries],
                  "query": [a.query for a in entries],
                  "state": [a.state for a in entries],
@@ -980,7 +1022,9 @@ class Session:
                  "plan_cache_hits": [delta(a, 0) for a in entries],
                  "plan_cache_misses": [delta(a, 1) for a in entries],
                  "feed_cache_hits": [delta(a, 2) for a in entries],
-                 "feed_cache_misses": [delta(a, 3) for a in entries]},
+                 "feed_cache_misses": [delta(a, 3) for a in entries],
+                 "hbm_live_bytes": [hbm_live] * len(entries),
+                 "hbm_peak_bytes": [hbm_peak] * len(entries)},
                 len(entries))
         elif e.name == "citus_stat_wlm":
             # workload-manager snapshot: gate occupancy + one row per
@@ -1039,6 +1083,40 @@ class Session:
                 "cache_invalidations_total": c["invalidations_total"],
                 "cache_last_lsn": c["last_lsn"],
             }
+            return ResultSet(list(cols),
+                             {k: [v] for k, v in cols.items()}, 1)
+        elif e.name == "citus_stat_memory":
+            # device-memory snapshot: the shared accountant's measured
+            # ledger (one per data_dir), this executor's degradation
+            # state, and the backend allocator's own stats where the
+            # platform exposes them (the cross-check; CPU test meshes
+            # report none)
+            from .executor.hbm import DeviceMemoryAccountant
+            from .stats import counters as sc
+
+            snap = self.executor.accountant.snapshot()
+            csnap = self.stats.counters.snapshot()
+            dev = DeviceMemoryAccountant.device_memory_stats()
+            cols = dict(snap)
+            cols["budget_bytes"] = \
+                self.executor.accountant.budget_bytes(self.settings)
+            cols["oom_events_total"] = csnap.get(sc.OOM_EVENTS_TOTAL, 0)
+            cols["cache_evictions_total"] = \
+                csnap.get(sc.CACHE_EVICTIONS_TOTAL, 0)
+            cols["stream_batch_shrinks_total"] = \
+                csnap.get(sc.STREAM_BATCH_SHRINKS_TOTAL, 0)
+            cols["spill_passes_total"] = \
+                csnap.get(sc.SPILL_PASSES_TOTAL, 0)
+            cols["degradation_batch_shrink"] = \
+                self.executor.oom.batch_shrink
+            cols["degradation_force_stream"] = \
+                self.executor.oom.force_stream
+            cols["degradation_multipass_k"] = \
+                self.executor.oom.multipass_k
+            cols["device_bytes_in_use"] = (
+                sum(d["bytes_in_use"] for d in dev) if dev else None)
+            cols["device_bytes_limit"] = (
+                min(d["bytes_limit"] for d in dev) if dev else None)
             return ResultSet(list(cols),
                              {k: [v] for k, v in cols.items()}, 1)
         elif e.name == "get_rebalance_progress":
@@ -1595,6 +1673,29 @@ class Session:
                     f"{idelta['corruption_detected']} (session totals: "
                     f"stripes_verified_total={sv_total} "
                     f"read_repairs_total={rr_total})")
+                # device-memory trip: this statement's OOM/degradation
+                # deltas (the Chunks Skipped pattern) + the shared
+                # accountant's measured ledger so memory pressure is
+                # auditable from one EXPLAIN ANALYZE
+                d_oom = snap.get(sc.OOM_EVENTS_TOTAL, 0) - \
+                    snap0.get(sc.OOM_EVENTS_TOTAL, 0)
+                d_ev = snap.get(sc.CACHE_EVICTIONS_TOTAL, 0) - \
+                    snap0.get(sc.CACHE_EVICTIONS_TOTAL, 0)
+                d_sp = snap.get(sc.SPILL_PASSES_TOTAL, 0) - \
+                    snap0.get(sc.SPILL_PASSES_TOTAL, 0)
+                msnap = self.executor.accountant.snapshot()
+                lines.append(
+                    f"{explain_tag('Memory')}: "
+                    f"oom_events={d_oom} cache_evictions={d_ev} "
+                    f"spill_passes={d_sp} "
+                    f"live={msnap['live_bytes']} "
+                    f"peak={msnap['peak_bytes']} "
+                    f"(session totals: oom_events_total="
+                    f"{snap.get(sc.OOM_EVENTS_TOTAL, 0)} "
+                    "stream_batch_shrinks_total="
+                    f"{snap.get(sc.STREAM_BATCH_SHRINKS_TOTAL, 0)} "
+                    "spill_passes_total="
+                    f"{snap.get(sc.SPILL_PASSES_TOTAL, 0)})")
                 lines.append(
                     f"{explain_tag('Resilience')}: "
                     f"retries={d_r} failovers={d_f} "

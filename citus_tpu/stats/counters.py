@@ -57,6 +57,12 @@ SERVING_BATCH_DISPATCH_TOTAL = "serving_batch_dispatch_total"
 SERVING_CACHE_HITS_TOTAL = "serving_cache_hits_total"
 SERVING_CACHE_MISSES_TOTAL = "serving_cache_misses_total"
 SERVING_CACHE_INVALIDATIONS_TOTAL = "serving_cache_invalidations_total"
+# device-memory governance (executor/hbm.py accountant + the OOM
+# degradation ladder in executor/runner.py degrade_for_oom)
+OOM_EVENTS_TOTAL = "oom_events_total"
+CACHE_EVICTIONS_TOTAL = "cache_evictions_total"
+STREAM_BATCH_SHRINKS_TOTAL = "stream_batch_shrinks_total"
+SPILL_PASSES_TOTAL = "spill_passes_total"
 # storage integrity (storage/integrity.py read-path accounting folded
 # in per statement; scrub counters from operations/scrubber.py)
 STRIPES_VERIFIED_TOTAL = "stripes_verified_total"
@@ -80,6 +86,8 @@ ALL_COUNTERS = [
     SERVING_BATCHED_LOOKUPS_TOTAL, SERVING_BATCH_DISPATCH_TOTAL,
     SERVING_CACHE_HITS_TOTAL, SERVING_CACHE_MISSES_TOTAL,
     SERVING_CACHE_INVALIDATIONS_TOTAL,
+    OOM_EVENTS_TOTAL, CACHE_EVICTIONS_TOTAL,
+    STREAM_BATCH_SHRINKS_TOTAL, SPILL_PASSES_TOTAL,
     STRIPES_VERIFIED_TOTAL, CORRUPTION_DETECTED_TOTAL,
     READ_REPAIRS_TOTAL, SCRUB_RUNS_TOTAL, SCRUB_REPAIRS_TOTAL,
 ]

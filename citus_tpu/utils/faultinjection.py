@@ -14,9 +14,10 @@ so named fault points sit at those seams and tests arm them:
 The engine mirrors mitmproxy's fluent vocabulary:
 
 * ``kill`` — the default: raise at the seam (`error="injected"` raises
-  InjectedFault, `error="storage"` raises StorageError — the
-  "connection lost" vs "disk error" distinction the retry classifier
-  cares about);
+  InjectedFault, `error="storage"` raises StorageError, `error="oom"`
+  raises DeviceMemoryExhausted — the "connection lost" vs "disk
+  error" vs "allocator OOM" distinctions the retry classifier cares
+  about);
 * ``delay`` — ``sleep=0.05`` sleeps at the seam first; with
   ``error=None`` the fault is delay-only (mitmproxy's ``delay()``);
 * ``after=N`` — trigger only after N successful passes
@@ -74,6 +75,9 @@ FAULT_POINTS: dict[str, str] = {
     "executor.agg_bucket_fill":
         "executor/compiler.py — bucketed group-by pack",
     "executor.device_put": "executor/feed.py — host→HBM placement",
+    "executor.hbm_exhausted":
+        "executor/hbm.py — accounted placement seam (arm with "
+        "error='oom' for a synthetic allocator RESOURCE_EXHAUSTED)",
     "executor.repartition_shuffle":
         "executor/insert_select.py — INSERT..SELECT repartition write",
     "stream.prefetch": "executor/stream.py — batch prefetch thread",
@@ -135,6 +139,14 @@ def fault_point(name: str) -> None:
     if kind == "storage":
         exc: Exception = StorageError(
             f"injected storage fault at {name!r}")
+    elif kind == "oom":
+        # the device-allocator failure kind: classified by the session
+        # retry envelope as retryable-after-degradation, so an armed
+        # memory fault exercises the whole OOM ladder (errors.py)
+        from ..errors import DeviceMemoryExhausted
+
+        exc = DeviceMemoryExhausted(
+            f"injected device OOM (RESOURCE_EXHAUSTED) at {name!r}")
     else:
         exc = InjectedFault(f"injected fault at {name!r}")
     exc.fault_point = name
@@ -147,8 +159,9 @@ def arm(name: str, after: int = 0, once: bool = True,
         error: str | None = "injected", seed: int | None = None) -> None:
     """Arm `name`.  `times` (trigger count before disarm) overrides
     `once`; `once=False, times=None` stays armed forever.  `error` picks
-    the raised kind ('injected' | 'storage') or None for delay-only."""
-    if error not in (None, "injected", "storage"):
+    the raised kind ('injected' | 'storage' | 'oom') or None for
+    delay-only."""
+    if error not in (None, "injected", "storage", "oom"):
         raise ValueError(f"unknown fault error kind {error!r}")
     with _lock:
         _armed[name] = {

@@ -5,6 +5,7 @@ see manager.py for the design)."""
 from .admission import (
     fastpath_exempt_shape,
     planned_feed_bytes,
+    planned_intermediate_bytes,
     read_tables,
     statement_exempt,
     statement_tables,
@@ -22,6 +23,7 @@ from .manager import (
 __all__ = [
     "PRIORITIES", "AdmissionRequest", "Ticket", "WorkloadManager",
     "fastpath_exempt_shape", "parse_tenant_weights", "planned_feed_bytes",
+    "planned_intermediate_bytes",
     "read_tables", "statement_exempt", "statement_tables",
     "statement_tenant", "workload_manager_for",
 ]

@@ -127,10 +127,13 @@ def read_tables(stmt: ast.Statement) -> set[str]:
     return statement_tables(stmt)
 
 
-def planned_feed_bytes(stmt: ast.Statement, catalog: Catalog, store,
-                       n_devices: int) -> int:
-    """Per-device feed-byte estimate for the HBM admission gate."""
-    total = 0
+def _base_table_bytes(stmt: ast.Statement, catalog: Catalog, store,
+                      n_devices: int) -> tuple[dict[str, int], int]:
+    """Per-device feed bytes by table + total row count for the
+    statement's read tables (the raw material of both the base-feed
+    and the intermediate estimates)."""
+    per_table: dict[str, int] = {}
+    rows = 0
     for t in read_tables(stmt):
         if not catalog.has_table(t):
             continue
@@ -139,13 +142,109 @@ def planned_feed_bytes(stmt: ast.Statement, catalog: Catalog, store,
             tbytes = sum(store.shard_size_bytes(t, s.shard_id)
                          for s in shards)
             meta = catalog.table(t)
+            rows += store.table_row_count(t)
         except (CatalogError, OSError, KeyError):
             continue  # table dropped/moved mid-estimate: skip its bytes
         if meta.method == DistributionMethod.HASH and n_devices > 0:
-            total += -(-tbytes // n_devices)
+            per_table[t] = -(-tbytes // n_devices)
         else:
-            total += tbytes  # reference/local tables replicate whole
+            per_table[t] = tbytes  # reference/local replicate whole
+    return per_table, rows
+
+
+def _count_joins(stmt: ast.Statement) -> int:
+    """Binary joins the statement's FROM clauses imply (explicit JOIN
+    nodes + comma cross sources + subquery bodies) — each one can cost
+    an all_to_all repartition + an output buffer at execution."""
+    if isinstance(stmt, ast.Explain):
+        return _count_joins(stmt.statement)
+    if isinstance(stmt, ast.InsertSelect):
+        return _count_joins(stmt.query)
+    if isinstance(stmt, ast.SetOp):
+        return _count_joins(stmt.left) + _count_joins(stmt.right)
+    if isinstance(stmt, ast.Merge):
+        return 1
+    if not isinstance(stmt, ast.Select):
+        return 0
+    joins = 0
+
+    def walk_fi(fi: ast.FromItem) -> None:
+        nonlocal joins
+        if isinstance(fi, ast.Join):
+            joins += 1
+            walk_fi(fi.left)
+            walk_fi(fi.right)
+        elif isinstance(fi, ast.SubqueryRef):
+            joins += _count_joins(fi.query)
+
+    for fi in stmt.from_items:
+        walk_fi(fi)
+    joins += max(0, len(stmt.from_items) - 1)
+    joins += len(stmt.semi_joins)
+    for cte in stmt.ctes:
+        joins += _count_joins(cte.query)
+    return joins
+
+
+def _has_group_by(stmt: ast.Statement) -> bool:
+    if isinstance(stmt, ast.Explain):
+        return _has_group_by(stmt.statement)
+    if isinstance(stmt, ast.InsertSelect):
+        return _has_group_by(stmt.query)
+    if isinstance(stmt, ast.SetOp):
+        return _has_group_by(stmt.left) or _has_group_by(stmt.right)
+    return isinstance(stmt, ast.Select) and bool(stmt.group_by)
+
+
+def planned_intermediate_bytes(stmt: ast.Statement, catalog: Catalog,
+                               store, n_devices: int,
+                               settings=None) -> int:
+    """Per-device estimate of the statement's STATIC PLAN INTERMEDIATES
+    — all_to_all repartition buffers, join outputs, bucket-probe/agg
+    grids.  The gate used to charge base-table feed bytes only, so a
+    statement whose intermediates alone exceeded the budget (a dual-
+    repartition join materializes ~n_dev× the larger side in its
+    shuffle buffers) admitted freely and OOM'd mid-flight.
+
+    Parse-tree-level, so deliberately coarse: each join charges
+    (repartition + output) headroom off the LARGEST read table, a
+    GROUP BY charges its dense-grid slots off the total row count.
+    The real plan's capacities refine this at execution; the gate only
+    needs to stop gross oversubscription."""
+    per_table, rows = _base_table_bytes(stmt, catalog, store, n_devices)
+    return _intermediates_from(stmt, per_table, rows, n_devices,
+                               settings)
+
+
+def _intermediates_from(stmt: ast.Statement, per_table: dict[str, int],
+                        rows: int, n_devices: int, settings) -> int:
+    if not per_table:
+        return 0
+    biggest = max(per_table.values())
+    repart_f = (settings.get("repartition_capacity_factor")
+                if settings is not None else 1.5)
+    join_f = (settings.get("join_output_capacity_factor")
+              if settings is not None else 1.0)
+    total = int(_count_joins(stmt) * (repart_f + join_f + 1.0) * biggest)
+    if _has_group_by(stmt):
+        from ..ops.groupby import GROUP_BUCKET_MAX_SLOTS
+
+        slots = min(GROUP_BUCKET_MAX_SLOTS,
+                    max(1, rows // max(1, n_devices)))
+        n_out = (len(stmt.items)
+                 if isinstance(stmt, ast.Select) else 4)
+        total += slots * 8 * (n_out + 2)
     return total
+
+
+def planned_feed_bytes(stmt: ast.Statement, catalog: Catalog, store,
+                       n_devices: int, settings=None) -> int:
+    """Per-device HBM estimate for the admission gate: base-table feed
+    bytes PLUS static plan intermediates (planned_intermediate_bytes).
+    One table walk serves both halves — admission is a hot path."""
+    per_table, rows = _base_table_bytes(stmt, catalog, store, n_devices)
+    return sum(per_table.values()) + _intermediates_from(
+        stmt, per_table, rows, n_devices, settings)
 
 
 def statement_tenant(stmt: ast.Statement, catalog: Catalog,

@@ -150,6 +150,16 @@ class WorkloadManager:
         # last-seen gate limits (display only — limits ride each request)
         self._last_max_slots = 0
         self._last_max_feed = 0
+        # measured device-byte pressure source: workload_manager_for
+        # attaches the data_dir's DeviceMemoryAccountant
+        # (executor/hbm.py), so the gate admits against
+        # max(planned-of-admitted, measured-live-non-evictable) — the
+        # planned ledger alone under-counts when executions regrow
+        # capacities or multiple passes overlap
+        self._measured_cb = None
+
+    def attach_measured(self, cb) -> None:
+        self._measured_cb = cb
 
     # -- admission ---------------------------------------------------------
     def admit(self, req: AdmissionRequest) -> Ticket:
@@ -246,8 +256,14 @@ class WorkloadManager:
             # gate off, or nothing running: a statement bigger than the
             # whole budget runs alone (streaming bounds its residency)
             return True
-        return (self._feed_inflight + req.feed_bytes
-                <= req.max_feed_bytes)
+        pressure = self._feed_inflight
+        if self._measured_cb is not None:
+            # cache-resident bytes are excluded at the source
+            # (accountant.pressure_bytes): they reclaim on demand via
+            # the OOM ladder's eviction rung, so they must not starve
+            # admission
+            pressure = max(pressure, int(self._measured_cb()))
+        return pressure + req.feed_bytes <= req.max_feed_bytes
 
     def _queue_blocks(self, priority: str) -> bool:
         """No barging: a new arrival queues behind waiters of its own
@@ -372,5 +388,11 @@ def workload_manager_for(data_dir: str) -> WorkloadManager:
     key = os.path.realpath(data_dir)
     with _registry_mu:
         if key not in _registry:
-            _registry[key] = WorkloadManager()
+            from ..executor.hbm import accountant_for
+
+            mgr = WorkloadManager()
+            # the gate and the accountant govern the same device:
+            # admission sees measured live bytes, not just plans
+            mgr.attach_measured(accountant_for(key).pressure_bytes)
+            _registry[key] = mgr
         return _registry[key]

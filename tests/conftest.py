@@ -30,7 +30,7 @@ import pytest  # noqa: E402
 # budget cutoff lands.
 _TIER1_FIRST = ("test_lint.py", "test_tools.py", "test_wlm.py",
                 "test_serving.py", "test_integrity.py",
-                "test_crash_torture.py")
+                "test_crash_torture.py", "test_oom_torture.py")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -1,0 +1,26 @@
+"""raw-device-placement fixtures: placements bypassing executor/hbm."""
+
+import jax
+
+from .distributed.mesh import put_replicated, put_sharded
+
+
+def bad_device_put(arr, sharding):
+    return jax.device_put(arr, sharding)
+
+
+def bad_put_sharded(mesh, arr):
+    return put_sharded(mesh, arr)
+
+
+def bad_put_replicated(mesh, arr):
+    return put_replicated(mesh, arr)
+
+
+def fine_accounted(accountant, mesh, arr):
+    # the sanctioned route: the accounted seam charges the ledger
+    return accountant.place(mesh, arr, True, "feed")
+
+
+def fine_ignored(arr, device):
+    return jax.device_put(arr, device)  # graftlint: ignore[raw-device-placement] — fixture: sanctioned probe

@@ -134,6 +134,36 @@ def test_readme_table_matches_newest_artifact(artifact):
         "\n  ".join(mismatches)
 
 
+def test_readme_memory_pressure_shares_match_artifact(artifact):
+    """The memory-pressure section may only quote driver-stamped
+    governed/ungoverned completion shares when the newest artifact
+    actually contains the memory_pressure lines — and then it must
+    quote THOSE shares (the degradation ladder's honesty contract:
+    no hand-picked runs)."""
+    with open(os.path.join(ROOT, "README.md")) as f:
+        text = f.read()
+    quoted = re.search(
+        r"(\d+(?:\.\d+)?)% governed vs (\d+(?:\.\d+)?)% ungoverned "
+        r"\(driver", text)
+    metrics = _artifact_metrics(artifact)
+    gov = metrics.get("memory_pressure_completed_share_governed")
+    ungov = metrics.get("memory_pressure_completed_share_ungoverned")
+    if gov is None or ungov is None:
+        assert quoted is None, (
+            "README quotes driver-stamped memory-pressure shares but "
+            f"{os.path.basename(artifact)} has no memory_pressure "
+            "capture")
+        return
+    want = (f"{gov['value'] * 100:g}", f"{ungov['value'] * 100:g}")
+    assert quoted is not None, (
+        f"{os.path.basename(artifact)} captures memory_pressure "
+        f"shares ({want[0]}%/{want[1]}%) but the README quotes no "
+        "driver-stamped numbers")
+    assert (quoted.group(1), quoted.group(2)) == want, (
+        f"README quotes {quoted.group(1)}%/{quoted.group(2)}% but the "
+        f"artifact says {want[0]}%/{want[1]}%")
+
+
 def test_readme_serving_multiplier_matches_artifact(artifact):
     """The serving section may only quote a driver-stamped batched-vs-
     per-statement multiplier when the newest artifact actually contains

@@ -76,6 +76,12 @@ FAULT_POOL = [
     dict(name="serving.batch_dispatch"),
     dict(name="serving.batch_dispatch", p=0.5, times=2),
     dict(name="serving.cache_fill"),
+    # memory faults (PR 10): a synthetic allocator OOM at the accounted
+    # placement seam must ride the degradation ladder (evict → shrink →
+    # stream → multi-pass) back to the oracle answer, or surface as a
+    # clean ResourceExhausted — never a dead process or wrong rows
+    dict(name="executor.hbm_exhausted", error="oom"),
+    dict(name="executor.hbm_exhausted", error="oom", p=0.5, times=2),
 ]
 
 

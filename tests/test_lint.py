@@ -86,6 +86,9 @@ GOLDEN = {
     ("raw-durable-write", "citus_tpu/rawwrite.py", 7),
     ("raw-durable-write", "citus_tpu/rawwrite.py", 11),
     ("raw-durable-write", "citus_tpu/rawwrite.py", 15),
+    ("raw-device-placement", "citus_tpu/rawplace.py", 9),
+    ("raw-device-placement", "citus_tpu/rawplace.py", 13),
+    ("raw-device-placement", "citus_tpu/rawplace.py", 17),
     ("host-sync-in-traced", "citus_tpu/executor/hot.py", 12),
     ("host-sync-in-traced", "citus_tpu/executor/hot.py", 13),
     ("host-sync-in-traced", "citus_tpu/executor/hot.py", 14),
@@ -132,7 +135,8 @@ def test_each_rule_family_has_a_firing_fixture():
                        "config-registry", "explain-tag-registry"},
         "discipline": {"bare-except", "swallowed-base-exception",
                        "swallowed-fault-seam", "silent-exception",
-                       "unowned-thread", "raw-durable-write"},
+                       "unowned-thread", "raw-durable-write",
+                       "raw-device-placement"},
     }
     for family, expected in families.items():
         assert expected <= rules, f"family {family} missing fixtures"

@@ -1,0 +1,396 @@
+"""Allocator-OOM torture: device-memory exhaustion at every allocation.
+
+The CrashSim pattern (tests/test_crash_torture.py) applied to the OTHER
+resource that dies mid-flight: device memory.  A MemSim armed on the
+per-data_dir accountant (citus_tpu/executor/hbm.py) raises synthetic
+RESOURCE_EXHAUSTED deterministically — at allocation N, or whenever a
+per-device byte budget would be exceeded — and the harness replays a
+join/agg/stream workload under every armed point asserting THE
+invariant:
+
+    every statement lands on the oracle-correct answer (via the
+    degradation ladder: cache eviction → stream-batch shrink → forced
+    streaming → multi-pass partitioned execution) XOR raises a clean
+    ResourceExhausted — zero process deaths, zero wrong rows, zero
+    accountant leaks (the live-bytes ledger returns to its cache-only
+    baseline after every statement).
+
+Tier-1 runs a strided slice of the allocation sweep; the full every-N
+sweep is additionally `slow`.
+"""
+
+import gc
+
+import numpy as np
+import pytest
+
+import citus_tpu
+from citus_tpu.errors import (
+    CitusTpuError,
+    PlanningError,
+    ResourceExhausted,
+)
+from citus_tpu.executor.hbm import oom_budget
+from citus_tpu.executor.runner import OomState
+
+# the torture workload: grouped agg, colocated join agg, repartition
+# join, plain rows with host combine — every statement's oracle is
+# recorded once, un-simulated, at module setup
+WORKLOAD = [
+    "SELECT grp, count(*), sum(v) FROM a GROUP BY grp ORDER BY grp",
+    "SELECT count(*), sum(a.v + b.w) FROM a, b WHERE a.id = b.id",
+    "SELECT count(*) FROM a, b WHERE a.v = b.id",
+    "SELECT id, v FROM a ORDER BY id LIMIT 7",
+]
+
+N_ROWS = 1200
+
+
+@pytest.fixture(scope="module")
+def sess(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("oomtorture"))
+    s = citus_tpu.connect(
+        data_dir=d, n_devices=2, serving_result_cache_bytes=0,
+        retry_backoff_base_ms=1, retry_backoff_max_ms=5)
+    s.execute("CREATE TABLE a (id INT, grp INT, v INT)")
+    s.execute("CREATE TABLE b (id INT, w INT)")
+    s.execute("SELECT create_distributed_table('a', 'id', 4)")
+    s.execute("SELECT create_distributed_table('b', 'id', 4)")
+    s.execute("INSERT INTO a VALUES " + ", ".join(
+        f"({i}, {i % 10}, {i})" for i in range(N_ROWS)))
+    s.execute("INSERT INTO b VALUES " + ", ".join(
+        f"({i}, {i * 3})" for i in range(N_ROWS)))
+    yield s
+    s.close()
+
+
+@pytest.fixture(scope="module")
+def oracle(sess):
+    return [sess.execute(sql).rows() for sql in WORKLOAD]
+
+
+def _reset_degradation(sess):
+    """Each armed point starts from a fresh ladder (sticky state from
+    a previous point would mask whether THIS point degrades)."""
+    sess.executor.oom = OomState()
+    sess.executor.feed_cache.clear()
+
+
+def _assert_no_leak(sess):
+    """The ledger must return to its cache-only baseline: transient
+    categories (feed/stream/plan) all released.  gc first — jax arrays
+    freed via reference cycles release their charges at collection."""
+    acc = sess.executor.accountant
+    if acc.transient_bytes():
+        gc.collect()
+    assert acc.transient_bytes() == 0, (
+        f"accountant leak: {acc.transient_bytes()} transient bytes "
+        f"live after statement ({acc.snapshot()})")
+
+
+def _run_workload(sess, oracle, expect_answer: bool = False) -> dict:
+    """One replay under whatever MemSim arming the caller installed.
+    Returns counts; asserts correct-answer XOR clean-error per
+    statement (`expect_answer=True` hardens to correct-answer-only).
+
+    The no-leak assert runs AFTER each try/except exits: while a
+    handler is active, sys.exc_info() pins the raising frames (and
+    through them the failed attempt's device feeds) — that is Python
+    exception semantics, not an accountant leak."""
+    stats = {"answered": 0, "clean_errors": 0}
+    for sql, want in zip(WORKLOAD, oracle):
+        got = None
+        clean_error = False
+        try:
+            got = sess.execute(sql).rows()
+        except ResourceExhausted:
+            assert not expect_answer, \
+                f"expected degradation to answer {sql!r}"
+            clean_error = True
+        except Exception as e:
+            assert isinstance(e, CitusTpuError), (
+                f"UNCLEAN failure {type(e).__name__}: {e!r} "
+                f"running {sql!r}")
+            raise AssertionError(
+                f"non-OOM error under memory torture running "
+                f"{sql!r}: {type(e).__name__}: {e}")
+        if clean_error:
+            stats["clean_errors"] += 1
+        else:
+            assert got == want, f"WRONG ROWS under OOM for {sql!r}"
+            stats["answered"] += 1
+        _assert_no_leak(sess)
+    return stats
+
+
+def _rehearse(sess, oracle) -> tuple[int, int]:
+    """Un-failing MemSim pass: (total allocations, peak live bytes) —
+    sizes the sweeps."""
+    _reset_degradation(sess)
+    acc = sess.executor.accountant
+    with oom_budget(acc) as sim:
+        _run_workload(sess, oracle, expect_answer=True)
+        peak = max(n for _i, _c, n in sim.journal) if sim.journal else 0
+        # peak LIVE during the rehearsal: budget sweeps key off it
+        live_peak = acc.peak_bytes
+    return sim.allocs, max(live_peak, peak)
+
+
+def _alloc_sweep(sess, oracle, stride: int):
+    total, _peak = _rehearse(sess, oracle)
+    assert total > 0, "workload placed nothing through the seam"
+    acc = sess.executor.accountant
+    for n in range(1, total + 1, stride):
+        _reset_degradation(sess)
+        with oom_budget(acc, fail_at=n) as sim:
+            # a single deterministic OOM at allocation n: the ladder
+            # must absorb it — every statement still answers correctly
+            stats = _run_workload(sess, oracle, expect_answer=True)
+        assert stats["answered"] == len(WORKLOAD)
+
+
+def test_allocation_sweep_tier1(sess, oracle):
+    """Strided slice of the every-allocation sweep (tier-1 budget)."""
+    total, _ = _rehearse(sess, oracle)
+    _alloc_sweep(sess, oracle, stride=max(1, total // 8))
+
+
+@pytest.mark.slow
+def test_allocation_sweep_full(sess, oracle):
+    """Every single allocation index fails once — the full sweep."""
+    _alloc_sweep(sess, oracle, stride=1)
+
+
+def test_budget_sweep(sess, oracle):
+    """Per-device byte budgets from hopeless to roomy: every statement
+    answers correctly (degraded where needed) XOR errors cleanly; at
+    least one constrained budget must complete BY degrading (the
+    ladder is proven, not just the error path), and a roomy budget
+    must complete without any OOM at all."""
+    _total, peak = _rehearse(sess, oracle)
+    acc = sess.executor.accountant
+    degraded_success = False
+    budgets = [peak // 8, peak // 4, peak // 2,
+               (peak * 3) // 4, (peak * 7) // 8, peak, peak * 2]
+    for budget in budgets:
+        _reset_degradation(sess)
+        with oom_budget(acc, budget=max(1, budget)) as sim:
+            stats = _run_workload(sess, oracle)
+        if stats["answered"] == len(WORKLOAD) and sim.oom_raised:
+            degraded_success = True
+        _assert_no_leak(sess)
+    assert degraded_success, (
+        "no budget in the sweep completed via degradation — the "
+        "ladder never proved itself")
+    _reset_degradation(sess)
+    with oom_budget(acc, budget=peak * 2) as sim:
+        _run_workload(sess, oracle, expect_answer=True)
+    assert sim.oom_raised == 0
+
+
+def test_multipass_matches_oracle(sess, oracle):
+    """Directed: force multi-pass partitioned execution (the ladder's
+    last functional rung) and pin every workload answer against the
+    un-degraded oracle — including composition with forced streaming."""
+    try:
+        for force_stream in (False, True):
+            _reset_degradation(sess)
+            sess.executor.oom = OomState(
+                batch_shrink=2 if force_stream else 1,
+                force_stream=force_stream, multipass_k=4)
+            for sql, want in zip(WORKLOAD, oracle):
+                got = sess.execute(sql).rows()
+                assert got == want, (
+                    f"multipass(force_stream={force_stream}) wrong "
+                    f"rows for {sql!r}")
+                _assert_no_leak(sess)
+    finally:
+        _reset_degradation(sess)
+
+
+def test_multipass_counts_spill_passes(sess, oracle):
+    """A forced-multipass join statement stamps spill passes into the
+    result + counters (the observability contract)."""
+    from citus_tpu.stats import counters as sc
+
+    try:
+        _reset_degradation(sess)
+        sess.executor.oom = OomState(multipass_k=4)
+        before = sess.stats.counters.snapshot()[sc.SPILL_PASSES_TOTAL]
+        r = sess.execute(WORKLOAD[1])
+        after = sess.stats.counters.snapshot()[sc.SPILL_PASSES_TOTAL]
+        assert r.spill_passes >= 2
+        assert after - before == r.spill_passes
+    finally:
+        _reset_degradation(sess)
+
+
+def test_oom_fault_injection_directed(sess, oracle):
+    """The executor.hbm_exhausted fault point armed with error='oom'
+    raises the classified DeviceMemoryExhausted at the placement seam;
+    the session ladder absorbs it and the statement still answers."""
+    from citus_tpu.stats import counters as sc
+    from citus_tpu.utils.faultinjection import inject
+
+    try:
+        _reset_degradation(sess)
+        snap0 = sess.stats.counters.snapshot()
+        with inject("executor.hbm_exhausted", error="oom"):
+            got = sess.execute(WORKLOAD[1]).rows()
+        assert got == oracle[1]
+        snap = sess.stats.counters.snapshot()
+        assert snap[sc.OOM_EVENTS_TOTAL] > snap0[sc.OOM_EVENTS_TOTAL]
+        assert snap[sc.FAULTS_INJECTED_TOTAL] > \
+            snap0[sc.FAULTS_INJECTED_TOTAL]
+        _assert_no_leak(sess)
+    finally:
+        _reset_degradation(sess)
+
+
+def test_oom_degradation_off_is_a_clean_error(sess, oracle):
+    """oom_degradation=off (the bench A/B's ungoverned arm): the first
+    OOM surfaces immediately as a clean ResourceExhausted subclass —
+    no ladder, no wrong rows."""
+    from citus_tpu.utils.faultinjection import inject
+
+    _reset_degradation(sess)
+    with sess.settings.override(oom_degradation=False):
+        with inject("executor.hbm_exhausted", error="oom"):
+            with pytest.raises(ResourceExhausted):
+                sess.execute(WORKLOAD[1])
+    _assert_no_leak(sess)
+
+
+def test_capacity_regrow_bounded_by_budget(sess, oracle):
+    """Satellite: an overflow-regrow that can no longer fit the armed
+    device budget degrades (stream / multi-pass) instead of retrying
+    into a guaranteed OOM.  Tiny capacity factors force overflows; the
+    budget is set so the REGROWN buffers (not the initial ones)
+    exceed it."""
+    _total, peak = _rehearse(sess, oracle)
+    acc = sess.executor.accountant
+    _reset_degradation(sess)
+    sess.executor.plan_cache.clear()
+    with sess.settings.override(join_output_capacity_factor=0.1,
+                                enable_capacity_feedback=False):
+        with oom_budget(acc, budget=peak):
+            # repartition join with 10× under-sized output buffers:
+            # must either converge via regrow WITHIN the budget or
+            # degrade — never a CapacityOverflowError after burned
+            # retries, never an unclean failure
+            sql = WORKLOAD[2]
+            want = oracle[2]
+            try:
+                got = sess.execute(sql).rows()
+                assert got == want
+            except ResourceExhausted:
+                pass
+    _assert_no_leak(sess)
+    _reset_degradation(sess)
+
+
+def test_plan_buffer_limit_routes_to_ladder(sess, oracle):
+    """Satellite: an over-limit plan whose shape the ladder can help
+    (streamable join) degrades instead of raising PlanningError —
+    correct answer XOR clean ResourceExhausted, and the OOM counter
+    proves the guard actually fired."""
+    from citus_tpu.stats import counters as sc
+
+    _reset_degradation(sess)
+    sess.executor.plan_cache.clear()
+    snap0 = sess.stats.counters.snapshot()
+    with sess.settings.override(max_plan_buffer_bytes=1 << 15):
+        try:
+            got = sess.execute(WORKLOAD[1]).rows()
+            assert got == oracle[1]
+        except ResourceExhausted:
+            pass
+        except PlanningError as e:
+            raise AssertionError(
+                f"eligible over-limit plan rejected instead of "
+                f"degraded: {e}")
+    snap = sess.stats.counters.snapshot()
+    assert snap[sc.OOM_EVENTS_TOTAL] > snap0[sc.OOM_EVENTS_TOTAL], \
+        "guard never routed into the ladder"
+    _assert_no_leak(sess)
+    _reset_degradation(sess)
+
+
+def test_plan_buffer_limit_clean_reject_for_cartesian(sess, oracle):
+    """Satellite: genuinely ineligible shapes (cartesian blowups) keep
+    the clean immediate PlanningError — degradation cannot shrink a
+    keyless product."""
+    _reset_degradation(sess)
+    with sess.settings.override(max_plan_buffer_bytes=1 << 16):
+        # a row-materializing keyless product (a pushed-down count(*)
+        # never allocates the pair buffer, so it sails under any limit)
+        with pytest.raises(PlanningError):
+            sess.execute("SELECT a.id, b.id FROM a, b LIMIT 5")
+    _reset_degradation(sess)
+
+
+def test_ledger_tracks_cache_and_releases_on_evict(sess, oracle):
+    """Measured-ledger sanity: cached feeds appear under the 'cache'
+    category; evicting them returns the bytes once the arrays are
+    garbage."""
+    acc = sess.executor.accountant
+    _reset_degradation(sess)
+    gc.collect()
+    sess.execute(WORKLOAD[1])
+    assert acc.live_bytes("cache") > 0
+    sess.executor.feed_cache.evict_coldest()
+    gc.collect()
+    assert acc.live_bytes("cache") == 0
+    _assert_no_leak(sess)
+
+
+def test_stat_memory_udf_and_explain_line(sess, oracle):
+    """Observability: citus_stat_memory() exposes the ledger and
+    degradation state; EXPLAIN ANALYZE renders the Memory: line."""
+    r = sess.execute("SELECT citus_stat_memory()")
+    row = {n: r.columns[n][0] for n in r.column_names}
+    for key in ("live_bytes", "peak_bytes", "oom_events_total",
+                "cache_evictions_total", "spill_passes_total",
+                "degradation_multipass_k", "memsim_armed",
+                "budget_bytes"):
+        assert key in row
+    assert row["peak_bytes"] >= row["live_bytes"]
+    plan = sess.execute("EXPLAIN ANALYZE " + WORKLOAD[1])
+    text = "\n".join(plan.columns["QUERY PLAN"])
+    assert "Memory:" in text
+    assert "oom_events=" in text and "peak=" in text
+
+
+def test_activity_exposes_hbm_columns(sess, oracle):
+    r = sess.execute("SELECT citus_stat_activity()")
+    assert "hbm_live_bytes" in r.column_names
+    assert "hbm_peak_bytes" in r.column_names
+
+
+@pytest.mark.slow
+def test_budget_sweep_with_writes(sess, oracle):
+    """Writes under memory pressure: an INSERT..SELECT whose device
+    half OOMs must retry-after-degradation without double-applying
+    (the device SELECT runs before any visibility flip)."""
+    acc = sess.executor.accountant
+    sess.execute("CREATE TABLE sink (id INT, v INT)")
+    sess.execute("SELECT create_distributed_table('sink', 'id', 4)")
+    _total, peak = _rehearse(sess, oracle)
+    try:
+        for budget in (peak // 2, peak, peak * 2):
+            sess.execute("DELETE FROM sink")
+            _reset_degradation(sess)
+            with oom_budget(acc, budget=max(1, budget)):
+                try:
+                    sess.execute(
+                        "INSERT INTO sink SELECT id, v FROM a")
+                except ResourceExhausted:
+                    continue
+            n = sess.execute(
+                "SELECT count(*) FROM sink").rows()[0][0]
+            assert int(n) == N_ROWS, \
+                f"partial/double apply under budget {budget}: {n}"
+            _assert_no_leak(sess)
+    finally:
+        sess.execute("DROP TABLE sink")
+        _reset_degradation(sess)

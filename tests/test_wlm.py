@@ -373,6 +373,93 @@ class TestSessionIntegration:
         # UPDATE reads its target before writing — it IS charged
         assert planned_feed_bytes(upd, sess.catalog, sess.store, 2) > 0
 
+    def test_feed_estimate_charges_plan_intermediates(self, sess):
+        """Under-charge regression: a dual-repartition join allocates
+        all_to_all shuffle buffers + join outputs far beyond its base
+        feeds — the gate estimate must include them, or statements
+        whose intermediates alone exceed the budget admit freely and
+        OOM mid-flight."""
+        from citus_tpu.sql import parse
+        from citus_tpu.wlm import (
+            planned_feed_bytes,
+            planned_intermediate_bytes,
+        )
+
+        # kv joined to itself on the NON-distribution column: neither
+        # side is pre-partitioned on the join key ⇒ dual repartition
+        dual = parse("SELECT count(*) FROM kv x, kv y "
+                     "WHERE x.v = y.v")[0]
+        scan = parse("SELECT count(*) FROM kv")[0]
+        inter = planned_intermediate_bytes(dual, sess.catalog,
+                                           sess.store, 2,
+                                           sess.settings)
+        assert inter > 0, "join plan charged no intermediates"
+        base_only = planned_feed_bytes(dual, sess.catalog, sess.store,
+                                       2, sess.settings) - inter
+        assert base_only > 0
+        assert inter > base_only, (
+            "a dual-repartition join's shuffle buffers dwarf its base "
+            f"feeds; estimate says {inter} <= {base_only}")
+        # a plain scan of the same table charges no join intermediates
+        scan_inter = planned_intermediate_bytes(
+            scan, sess.catalog, sess.store, 2, sess.settings)
+        assert scan_inter == 0
+
+    def test_hbm_gate_blocks_on_intermediates(self, sess):
+        """The gate end: with a budget sized between one and two
+        statements' FULL estimates (base + intermediates), a second
+        concurrent dual-repartition statement must wait — under the
+        old base-only charge both fit and oversubscribed the device."""
+        import threading as _threading
+        import time as _time
+
+        from citus_tpu.sql import parse
+        from citus_tpu.wlm import (
+            AdmissionRequest,
+            WorkloadManager,
+            planned_feed_bytes,
+        )
+
+        dual = parse("SELECT count(*) FROM kv x, kv y "
+                     "WHERE x.v = y.v")[0]
+        full = planned_feed_bytes(dual, sess.catalog, sess.store, 2,
+                                  sess.settings)
+        mgr = WorkloadManager()
+        budget = int(full * 1.5)
+        first = mgr.admit(AdmissionRequest(
+            feed_bytes=full, max_slots=8, max_feed_bytes=budget))
+        got = []
+        th = _threading.Thread(target=lambda: got.append(mgr.admit(
+            AdmissionRequest(feed_bytes=full, max_slots=8,
+                             max_feed_bytes=budget))))
+        th.start()
+        _time.sleep(0.1)
+        assert not got, ("second dual-repartition statement must wait "
+                         "for the HBM budget")
+        mgr.release(first)
+        th.join(timeout=5)
+        assert len(got) == 1
+        mgr.release(got[0])
+
+    def test_gate_consults_measured_pressure(self, sess):
+        """The manager admits against max(planned, measured): a
+        measured live-byte spike the plans never declared (capacity
+        regrow, overlapping passes) blocks further admissions."""
+        from citus_tpu.wlm import AdmissionRequest, WorkloadManager
+
+        mgr = WorkloadManager()
+        measured = {"v": 0}
+        mgr.attach_measured(lambda: measured["v"])
+        a = mgr.admit(AdmissionRequest(feed_bytes=10, max_slots=8,
+                                       max_feed_bytes=100))
+        measured["v"] = 95  # regrow blew past the declared 10
+        assert not mgr._fits(AdmissionRequest(
+            feed_bytes=10, max_slots=8, max_feed_bytes=100))
+        measured["v"] = 0
+        assert mgr._fits(AdmissionRequest(
+            feed_bytes=10, max_slots=8, max_feed_bytes=100))
+        mgr.release(a)
+
     def test_background_job_admits_at_background_priority(self, sess):
         ran = []
         job = sess.jobs.submit_job("unit", [(lambda: ran.append(1),

@@ -51,7 +51,7 @@ def by_file(totals: dict[str, float]) -> dict[str, float]:
 # runs these files before the alphabetical remainder
 TIER1_FIRST = ("test_lint.py", "test_tools.py", "test_wlm.py",
                "test_serving.py", "test_integrity.py",
-               "test_crash_torture.py")
+               "test_crash_torture.py", "test_oom_torture.py")
 
 
 def budget_cutoff(totals: dict[str, float], budget: float) -> list[str]:
