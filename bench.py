@@ -78,27 +78,42 @@ def bench_cold_scan(sess, n_rows: int):
     aggregate, with the HBM feed cache emptied first (the plan stays
     compiled — this measures the data path, not XLA).
 
-    Returns (rate, best, parts, reps): `parts` decomposes the cold time
-    into host decode (stripe read + decompress, measured separately over
-    the same columns) vs the remainder (device_put through whatever link
-    attaches the chip + dispatch); `reps` is the measured-execution
-    count the caller stamps on the emitted line, so the published
-    repeats can never drift from the loop that actually ran.  On the
-    tunnel-attached measurement rig the transfer leg dominates — the
-    sub-metrics let the published line say so without a PERF_NOTES
-    cross-reference."""
+    Runs the cold scan in the session's resolved scan_pipeline mode AND
+    with the pipeline forced off, so the artifact itself carries the
+    overlapped-vs-eager A/B; the pipelined run's per-phase walls
+    (prefetch+decode, host wire-encode, transfer dispatch, on-device
+    decode) and its bytes_on_wire vs bytes_decoded ratio come from the
+    executor's ScanPhaseStats (reset per rep; the best rep's snapshot
+    is published).  Returns (rate, best, parts, reps, eager_rate,
+    eager_best); `parts` keeps the legacy host-decode/transfer split
+    (measured separately over the same columns) next to the new phase
+    keys so older artifact consumers still parse."""
+    from citus_tpu.executor.scanpipe import resolve_scan_mode
+
     sql = ("select sum(l_quantity), sum(l_extendedprice), "
            "sum(l_discount), sum(l_tax) from lineitem")
     sess.execute(sql)  # compile + warm
     bytes_scanned = n_rows * 4 * 8  # four float64 columns as stored
-    best = float("inf")
     reps = 2
-    for _ in range(reps):
-        sess.executor.feed_cache.clear()
-        t0 = time.perf_counter()
-        r = sess.execute(sql)
-        best = min(best, time.perf_counter() - t0)
-        assert r.row_count == 1
+    mode = resolve_scan_mode(sess.settings)
+
+    def run_mode(m):
+        best, best_stats = float("inf"), {}
+        with sess.settings.override(scan_pipeline=m):
+            for _ in range(reps):
+                sess.executor.feed_cache.clear()
+                sess.executor.scan_stats.reset()
+                t0 = time.perf_counter()
+                r = sess.execute(sql)
+                dt = time.perf_counter() - t0
+                if dt < best:
+                    best = dt
+                    best_stats = sess.executor.scan_stats.snapshot()
+                assert r.row_count == 1
+        return best, best_stats
+
+    best, stats = run_mode(mode)
+    eager_best, _ = run_mode("off")
     # host-only leg: same stripe read + decompress, no device
     cols = ["l_quantity", "l_extendedprice", "l_discount", "l_tax"]
     decode_best = float("inf")
@@ -116,11 +131,38 @@ def bench_cold_scan(sess, n_rows: int):
         "host_decode_seconds": round(decode_best, 4),
         "host_decode_gb_per_sec": round(
             decoded_bytes / decode_best / 1e9, 3),
-        "transfer_and_dispatch_seconds": round(best - decode_best, 4),
+        # legacy split: decode vs remainder of the EAGER arm (the
+        # pipelined arm overlaps the phases, so subtracting the serial
+        # decode leg from its wall would not decompose anything and
+        # could go negative) — the pipelined arm's decomposition is
+        # the phase_* keys below
+        "transfer_and_dispatch_seconds": round(
+            max(0.0, eager_best - decode_best), 4),
         "bytes_decoded": decoded_bytes,
         "bytes_to_device": bytes_scanned,
+        # pipelined-scan phase breakdown (best pipelined rep)
+        "scan_pipeline": mode,
+        "phase_prefetch_decode_seconds": stats.get(
+            "prefetch_seconds", 0.0),
+        "phase_wire_encode_seconds": stats.get("decode_seconds", 0.0),
+        "phase_transfer_dispatch_seconds": stats.get(
+            "transfer_seconds", 0.0),
+        "phase_device_decode_seconds": stats.get(
+            "device_decode_seconds", 0.0),
+        "prefetch_stalls": stats.get("prefetch_stalls", 0),
+        "bytes_on_wire": stats.get("bytes_on_wire", 0),
+        "bytes_decoded_pipeline": stats.get("bytes_decoded", 0),
+        "wire_ratio": (round(stats["bytes_on_wire"]
+                             / stats["bytes_decoded"], 4)
+                       if stats.get("bytes_decoded") else None),
+        "transfer_wall_share": round(
+            min(1.0, stats.get("transfer_seconds", 0.0) / best), 4)
+        if best else None,
+        "eager_seconds": round(eager_best, 4),
+        "vs_eager": round(eager_best / best, 3) if best else None,
     }
-    return bytes_scanned / best / 1e9, best, parts, reps
+    return (bytes_scanned / best / 1e9, best, parts, reps,
+            bytes_scanned / eager_best / 1e9, eager_best)
 
 
 def bench_concurrency() -> None:
@@ -664,10 +706,16 @@ def main() -> None:
             emit(name, rate, best, sf, reps=repeats)
         if ((only is None or "columnar_scan_gb_per_sec" in only)
                 and not over_budget(0.7)):
-            rate, best, parts, scan_reps = bench_cold_scan(sess, n_li)
+            (rate, best, parts, scan_reps,
+             eager_rate, eager_best) = bench_cold_scan(sess, n_li)
             emit("columnar_scan_gb_per_sec", rate, best, sf, unit="GB/s",
                  baseline=BASELINE_SCAN_GB_PER_SEC, extra=parts,
                  reps=scan_reps)
+            # the eager (scan_pipeline=off) arm of the same cold scan:
+            # the artifact itself carries the pipelined-vs-eager A/B
+            emit("columnar_scan_gb_per_sec_eager", eager_rate,
+                 eager_best, sf, unit="GB/s",
+                 baseline=BASELINE_SCAN_GB_PER_SEC, reps=scan_reps)
             # the host-only decode leg as its own line: on a
             # tunnel-attached rig the end-to-end number above measures
             # the link, not the stripe reader

@@ -161,6 +161,29 @@ _register(ConfigVar(
     "max_feed_bytes_per_device budget). Test/tuning knob.",
     int, min_value=0, max_value=1 << 30))
 _register(ConfigVar(
+    "scan_pipeline", "auto",
+    "Columnar scan feed pipeline (executor/scanpipe.py): 'off' = the "
+    "eager read-everything-then-transfer path; 'host' = prefetch + "
+    "native-codec decode on a producer thread overlapped with device "
+    "placement, column by column; 'device' = host pipeline plus "
+    "on-device decode — frame-of-reference packed ints, dictionary-"
+    "coded low-NDV columns and bit-packed validity planes cross the "
+    "wire and expand on the mesh (Pallas kernels on TPU, XLA "
+    "formulations elsewhere). 'auto' picks device on accelerator "
+    "backends and host on CPU meshes, engaging only above a small "
+    "row floor (same measurement-gated contract as join_probe_kernel "
+    "/ group_by_kernel). No reference GUC — the analogue is the "
+    "columnar reader's chunk streaming, columnar_reader.c:323.",
+    str, choices=("auto", "off", "host", "device")))
+_register(ConfigVar(
+    "scan_prefetch_depth", 2,
+    "Bounded depth of the pipelined-scan prefetch queue (columns in "
+    "flight between the decode producer and the placing consumer) and "
+    "of the stream path's batch prefetch queue.  Higher depths hide "
+    "more decode latency behind transfer at the cost of prefetch-"
+    "category HBM residency (the OOM ladder sheds prefetch first).",
+    int, min_value=1, max_value=64))
+_register(ConfigVar(
     "max_plan_buffer_bytes", 32 << 30,
     "Ceiling on a plan's largest static device buffer. Plans over it "
     "whose shape the OOM degradation ladder can help (streamable / "

@@ -45,7 +45,8 @@ def walk_plan(node: PlanNode):
 def build_feeds(plan: QueryPlan, catalog: Catalog, store: TableStore,
                 mesh: Mesh, compute_dtype=np.float32,
                 cache=None, counters=None, accountant=None,
-                no_cache_nodes=frozenset()) -> dict[int, FeedSpec]:
+                no_cache_nodes=frozenset(), stats=None
+                ) -> dict[int, FeedSpec]:
     """`no_cache_nodes`: node ids whose feeds bypass the device cache —
     the multipass driver's per-pass split feeds must NOT pin every
     pass's partition resident at once (that would defeat the pass)."""
@@ -56,7 +57,7 @@ def build_feeds(plan: QueryPlan, catalog: Catalog, store: TableStore,
             feeds[id(node)] = _feed_scan_cached(node, catalog, store, mesh,
                                                 plan.n_devices, compute_dtype,
                                                 node_cache, counters,
-                                                accountant)
+                                                accountant, stats)
     return feeds
 
 
@@ -153,7 +154,8 @@ def _overlay_touches(store: TableStore, table: str) -> bool:
 
 def _feed_scan_cached(node: ScanNode, catalog: Catalog, store: TableStore,
                       mesh: Mesh, n_dev: int, compute_dtype,
-                      cache, counters=None, accountant=None) -> FeedSpec:
+                      cache, counters=None, accountant=None,
+                      stats=None) -> FeedSpec:
     """Device-feed cache wrapper: HBM-resident table arrays keyed on
     (table, columns, pruning, placement, data version) — see
     executor/cache.py.  Open-transaction overlays bypass the cache (their
@@ -161,15 +163,24 @@ def _feed_scan_cached(node: ScanNode, catalog: Catalog, store: TableStore,
     table = node.rel.table
     if cache is None or _overlay_touches(store, table):
         return _feed_scan(node, catalog, store, mesh, n_dev, compute_dtype,
-                          counters, accountant, category="feed")
+                          counters, accountant, category="feed",
+                          stats=stats)
     shards = catalog.table_shards(table)
     placement_sig = tuple(
         (s.shard_id, catalog.active_placement(s.shard_id).node_id)
         for s in shards)
+    # skip-filter fingerprint under STORAGE column names — the names the
+    # chunk filter actually tests stripe stats against.  Keying on the
+    # current names would let two filters that alias through a rename
+    # share one skip-pruned (possibly prefetched) feed; the mapped
+    # fingerprint makes cacheability a function of what was READ
+    skip_fp = tuple(
+        (store.storage_column_name(table, col), op, val)
+        for col, op, val in skippable_tests(node.filter))
     key = (table, store.data_version(table), tuple(node.columns),
            None if node.pruned_shards is None else tuple(node.pruned_shards),
            n_dev, str(np.dtype(compute_dtype)), placement_sig,
-           skippable_tests(node.filter))
+           skip_fp)
     entry = cache.get(key)
     if entry is None:
         # superseded versions of this table can never hit again — free
@@ -179,7 +190,8 @@ def _feed_scan_cached(node: ScanNode, catalog: Catalog, store: TableStore,
         # cache-resident below, and cache bytes are the evictable
         # class the ladder/admission pressure treats as reclaimable
         spec = _feed_scan(node, catalog, store, mesh, n_dev, compute_dtype,
-                          counters, accountant, category="cache")
+                          counters, accountant, category="cache",
+                          stats=stats)
         from .cache import CachedFeed
 
         nbytes = sum(int(np.dtype(a.dtype).itemsize * a.size)
@@ -198,7 +210,20 @@ def _feed_scan_cached(node: ScanNode, catalog: Catalog, store: TableStore,
 def _feed_scan(node: ScanNode, catalog: Catalog, store: TableStore,
                mesh: Mesh, n_dev: int, compute_dtype,
                counters=None, accountant=None,
-               category: str = "feed") -> FeedSpec:
+               category: str = "feed", stats=None) -> FeedSpec:
+    # pipelined path first (executor/scanpipe.py): prefetch + decode on
+    # a producer thread overlapped with accounted placement, optional
+    # on-device decode.  None ⇒ ineligible (scan_pipeline off, tiny
+    # table under 'auto', open overlay) or shed after a prefetch OOM —
+    # the eager path below is both the fallback and the reference
+    # semantics the fuzzer parity slice pins the pipeline to.
+    from .scanpipe import maybe_pipelined_feed
+
+    pipelined = maybe_pipelined_feed(node, catalog, store, mesh, n_dev,
+                                     compute_dtype, counters, accountant,
+                                     category, stats)
+    if pipelined is not None:
+        return pipelined
     rel = node.rel
     meta = catalog.table(rel.table)
     colnames = [cid.split(".", 1)[1] for cid in node.columns]

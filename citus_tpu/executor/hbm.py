@@ -35,6 +35,15 @@ Charge categories:
                     OOM ladder's first rung frees them, so they do not
                     count against admission pressure)
 * ``stream``      — in-flight stream/multipass batch arrays
+* ``prefetch``    — pipelined-scan buffers placed AHEAD of consumption
+                    (executor/scanpipe.py): wire payloads awaiting
+                    on-device decode and feed columns still in the
+                    prefetch queue.  Sheddable first: an OOM during a
+                    pipelined feed drains the pipeline and the feed
+                    retries eagerly, so these bytes never pin a
+                    statement.  The charge graduates to its final
+                    category (``recharge``) when the consumer adopts
+                    the array into the feed.
 * ``plan``        — leased static plan-buffer estimate of an executing
                     program
 * ``other``       — anything else routed through the seam
@@ -53,7 +62,7 @@ import weakref
 
 from ..errors import DeviceMemoryExhausted
 
-CATEGORIES = ("feed", "cache", "stream", "plan", "other")
+CATEGORIES = ("feed", "cache", "stream", "prefetch", "plan", "other")
 
 # substring the XLA allocator (and MemSim, deliberately) puts in every
 # device-OOM message — the classification key
@@ -118,6 +127,16 @@ class DeviceMemoryAccountant:
         """Place one host array on the mesh through the accounted seam.
         Returns the device array; raises DeviceMemoryExhausted when the
         allocator (real or simulated) refuses."""
+        out, _handle = self.place_tracked(mesh, arr, sharded, category)
+        return out
+
+    def place_tracked(self, mesh, arr, sharded: bool,
+                      category: str = "feed"):
+        """`place` returning ``(array, charge_handle)`` — the pipelined
+        scan path (executor/scanpipe.py) places columns under the
+        sheddable ``prefetch`` category while they sit in the prefetch
+        queue and graduates the charge via :meth:`recharge` when the
+        consumer adopts them into the feed."""
         from ..distributed.mesh import put_replicated, put_sharded
         from ..utils.faultinjection import fault_point
 
@@ -142,7 +161,38 @@ class DeviceMemoryAccountant:
                 raise err from e
             raise
         weakref.finalize(out, self._release, handle)
-        return out
+        return out, handle
+
+    def recharge(self, handle: int, category: str) -> None:
+        """Move a live charge to another category (pipelined feed
+        columns graduate prefetch → feed/cache on adoption).  A handle
+        whose charge already released (array died mid-pipeline) is a
+        no-op."""
+        if category not in CATEGORIES:
+            category = "other"
+        with self._mu:
+            entry = self._live.get(handle)
+            if entry is None:
+                return
+            old_cat, nbytes = entry
+            if old_cat == category:
+                return
+            self._live[handle] = (category, nbytes)
+            self._live_by_cat[old_cat] -= nbytes
+            self._live_by_cat[category] += nbytes
+
+    def adopt(self, arr, sharded: bool, n_dev: int,
+              category: str = "feed") -> None:
+        """Charge a device array the seam did NOT place — the output of
+        an on-device decode (a compiled expansion of a wire payload,
+        allocated by XLA where `place` cannot see it).  The charge is
+        measured (released by the array's finalizer) so decoded feeds
+        stay visible to the ledger, the WLM gate and MemSim exactly
+        like host-placed ones."""
+        nbytes = (int(arr.nbytes) if not sharded or n_dev <= 0
+                  else -(-int(arr.nbytes) // n_dev))
+        handle = self._charge(category, nbytes)
+        weakref.finalize(arr, self._release, handle)
 
     @contextlib.contextmanager
     def lease(self, category: str, nbytes: int):

@@ -150,6 +150,12 @@ class Executor:
 
         self.accountant = accountant_for(store.data_dir)
         self.accountant.register_evictable(self.feed_cache)
+        # scan-pipeline phase accounting (executor/scanpipe.py): the
+        # bench drivers reset + read this to stamp prefetch/decode/
+        # transfer walls and the bytes-on-wire ratio into the artifact
+        from .scanpipe import ScanPhaseStats
+
+        self.scan_stats = ScanPhaseStats()
         self.oom = OomState()
         # per-thread plan of the in-flight statement: the degradation
         # ladder peeks at it to skip rungs that cannot help this shape
@@ -207,7 +213,8 @@ class Executor:
                             compute_dtype, cache=self.feed_cache,
                             counters=self.counters,
                             accountant=self.accountant,
-                            no_cache_nodes=no_cache_nodes)
+                            no_cache_nodes=no_cache_nodes,
+                            stats=self.scan_stats)
         # device_topk + its ORDER BY keys are traced into the program
         topk_sig = (plan.device_topk, tuple(
             (repr(e), d, nf) for e, d, nf in plan.host_order_by)

@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 
 import numpy as np
 
@@ -153,7 +154,8 @@ def stream_candidates(plan: QueryPlan, catalog) -> list[ScanNode]:
 
 def pick_stream_node(plan: QueryPlan, catalog, store, n_dev: int,
                      compute_dtype, budget: int, forced_rows: int = 0,
-                     shrink: int = 1, force: bool = False):
+                     shrink: int = 1, force: bool = False,
+                     prefetch_depth: int = 1):
     """(stream ScanNode, batch_cap) or None.
 
     Streams only when the combined per-device feed bytes exceed `budget`
@@ -165,7 +167,13 @@ def pick_stream_node(plan: QueryPlan, catalog, store, n_dev: int,
     batch_cap (each level is one recompile, memoized via the plan
     fingerprint), `force` streams even when the feeds fit the
     configured budget — a real allocator OOM proved the effective
-    ceiling lower than the configured one."""
+    ceiling lower than the configured one.
+
+    `prefetch_depth` is the bounded batch-queue depth
+    (scan_prefetch_depth): depth+1 batches can be device-resident at
+    once, so the per-batch budget divisor scales with it — a deeper
+    queue must mean smaller batches, never more resident bytes than
+    the budget the streaming path exists to honor."""
     scans = [n for n in walk_plan(plan.root) if isinstance(n, ScanNode)]
     sizes = {}
     for s in scans:
@@ -186,12 +194,14 @@ def pick_stream_node(plan: QueryPlan, catalog, store, n_dev: int,
     if forced_rows:
         return stream, _round_cap(max(1, forced_rows // max(1, shrink)))
     other = total - sizes[id(stream)]
-    # double-buffering + downstream join/shuffle intermediates sized off
-    # the batch: budget the stream batch at 1/6 of what remains
+    # resident batches (depth queued + 1 consumed) + downstream join/
+    # shuffle intermediates sized off the batch: budget each batch at
+    # 1/(depth+5) of what remains (depth 1 keeps the historic 1/6)
+    div = max(1, int(prefetch_depth)) + 5
     avail = budget - other
-    if avail < 6 * width * 4096 and not force:
+    if avail < div * width * 4096 and not force:
         return None  # other feeds leave no useful room — fall through
-    batch_cap = int(max(avail, 6 * width * 1024) // (6 * width))
+    batch_cap = int(max(avail, div * width * 1024) // (div * width))
     if force:
         # a forced stream must actually batch: at least 2 batches even
         # when the sizing math says everything fits — and the usual
@@ -215,9 +225,11 @@ class StreamBatcher:
     feed batches, reading lazily (at most one open stripe per device)."""
 
     def __init__(self, node: ScanNode, catalog, store, mesh, n_dev: int,
-                 compute_dtype, batch_cap: int, accountant=None):
+                 compute_dtype, batch_cap: int, accountant=None,
+                 stats=None):
         from .hbm import accountant_for
 
+        self.stats = stats
         self.node = node
         self.catalog = catalog
         self.store = store
@@ -317,7 +329,11 @@ class StreamBatcher:
         need one execution)."""
         node, rel = self.node, self.node.rel
         cap, n_dev = self.batch_cap, self.n_dev
+        t_pull = time.perf_counter()
         per_dev = [self._pull(d, cap) for d in range(n_dev)]
+        if self.stats is not None:
+            self.stats.add(
+                stream_decode_seconds=time.perf_counter() - t_pull)
         self.last_rows = sum(got for _v, got in per_dev)
         if batch_index > 0 and self.last_rows == 0:
             return None
@@ -353,9 +369,13 @@ class StreamBatcher:
         def put(a):
             return acc.place(self.mesh, a, True, "stream")
 
+        t_put = time.perf_counter()
         feed.arrays = {c: put(a) for c, a in feed.arrays.items()}
         feed.nulls = {c: put(a) for c, a in feed.nulls.items()}
         feed.valid = put(feed.valid)
+        if self.stats is not None:
+            self.stats.add(
+                stream_transfer_seconds=time.perf_counter() - t_put)
         return feed
 
 
@@ -471,7 +491,9 @@ def try_execute_streamed(executor, plan: QueryPlan, raw: bool,
                               n_dev, compute_dtype, budget,
                               settings.get("stream_batch_rows"),
                               shrink=oom.batch_shrink,
-                              force=oom.force_stream)
+                              force=oom.force_stream,
+                              prefetch_depth=settings.get(
+                                  "scan_prefetch_depth"))
     if picked is None:
         return None
     stream_node, batch_cap = picked
@@ -486,7 +508,8 @@ def try_execute_streamed(executor, plan: QueryPlan, raw: bool,
 
     batcher = StreamBatcher(stream_node, executor.catalog, executor.store,
                             executor.mesh, n_dev, compute_dtype, batch_cap,
-                            accountant=executor.accountant)
+                            accountant=executor.accountant,
+                            stats=executor.scan_stats)
     feeds: dict[int, FeedSpec] = {}
     for node in walk_plan(plan.root):
         if isinstance(node, ScanNode) and node is not stream_node:
@@ -495,13 +518,17 @@ def try_execute_streamed(executor, plan: QueryPlan, raw: bool,
             feeds[id(node)] = _feed_scan_cached(
                 node, executor.catalog, executor.store, executor.mesh,
                 n_dev, compute_dtype, cache,
-                executor.counters, executor.accountant)
+                executor.counters, executor.accountant,
+                executor.scan_stats)
 
     # prefetch thread: builds + device_puts the next batch while the mesh
-    # chews the current one.  stop_evt lets a failing consumer unblock
-    # the producer's bounded put (a plain put would pin the thread and a
-    # device-resident batch forever).
-    fetched: queue.Queue = queue.Queue(maxsize=1)
+    # chews the current one (scan_prefetch_depth batches in flight —
+    # the same knob that bounds the pipelined scan's column prefetch).
+    # stop_evt lets a failing consumer unblock the producer's bounded
+    # put (a plain put would pin the thread and a device-resident batch
+    # forever).
+    fetched: queue.Queue = queue.Queue(
+        maxsize=max(1, settings.get("scan_prefetch_depth")))
     stop_evt = threading.Event()
 
     def _put(item) -> bool:

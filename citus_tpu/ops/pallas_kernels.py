@@ -42,6 +42,7 @@ except Exception:  # pragma: no cover
 ROW_TILE = 1024       # rows per grid step
 K_CHUNK = 512         # one-hot width per MXU feed
 PROBE_CHUNK = 512     # probe rows streamed per step through one tile
+BITS_CHUNK = 128      # packed bytes per bit-unpack step (→ 1024 lanes)
 
 
 def _round_up(n: int, m: int) -> int:
@@ -250,6 +251,96 @@ if _PALLAS_OK:
             interpret=interpret,
         )(slot_flat, val_flat)
         return out.reshape(nb, k_pad, a_pad)[:, :tile, :a]
+
+
+if _PALLAS_OK:
+
+    def _bitunpack_kernel(packed_ref, out_ref):
+        """One grid step: unpack BITS_CHUNK packed bytes into
+        BITS_CHUNK×8 byte-per-bit lanes (MSB-first — numpy packbits
+        order).  A lane-dimension gather picks each output bit's source
+        byte (the reshape-free formulation Mosaic lowers as a vector
+        dynamic-gather, like the probe kernel's take_along_axis)."""
+        p = packed_ref[:].astype(jnp.int32)            # [1, C]
+        j = jax.lax.broadcasted_iota(jnp.int32, (1, p.shape[1] * 8), 1)
+        byte = jnp.take_along_axis(p, j // 8, axis=1)
+        out_ref[:] = ((byte >> (7 - (j % 8))) & 1).astype(jnp.uint8)
+
+    @functools.partial(jax.jit, static_argnames=("cap", "interpret"))
+    def bit_unpack_pallas(packed: jnp.ndarray, cap: int,
+                          interpret: bool = False) -> jnp.ndarray:
+        """On-device validity-plane unpack for the pipelined scan
+        (executor/scanpipe.py, scan_pipeline=device): packed
+        [rows, cap//8] uint8 (numpy packbits, MSB-first) → [rows, cap]
+        bool.  8× fewer bytes cross the wire than the byte-per-row
+        plane the eager feed path transfers."""
+        rows, w = packed.shape
+        w_pad = _round_up(max(w, BITS_CHUNK), BITS_CHUNK)
+        if w_pad != w:
+            packed = jnp.zeros((rows, w_pad), jnp.uint8) \
+                .at[:, :w].set(packed)
+        out = pl.pallas_call(
+            _bitunpack_kernel,
+            grid=(rows, w_pad // BITS_CHUNK),
+            in_specs=[pl.BlockSpec((1, BITS_CHUNK),
+                                   lambda i, j: (i, j))],
+            out_specs=pl.BlockSpec((1, BITS_CHUNK * 8),
+                                   lambda i, j: (i, j)),
+            out_shape=jax.ShapeDtypeStruct((rows, w_pad * 8),
+                                           jnp.uint8),
+            interpret=interpret,
+        )(packed)
+        return out[:, :cap].astype(bool)
+
+    def _dictdecode_kernel(lut_ref, codes_ref, out_ref):
+        """One grid step: gather PROBE_CHUNK codes against the resident
+        LUT tile (index map ignores the chunk grid dim, so the LUT
+        streams HBM→VMEM once per row — the probe kernel's pattern)."""
+        out_ref[:] = jnp.take_along_axis(lut_ref[:], codes_ref[:],
+                                         axis=1)
+
+    @functools.partial(jax.jit, static_argnames=("interpret",))
+    def dict_decode_pallas(codes: jnp.ndarray, lut: jnp.ndarray,
+                           interpret: bool = False) -> jnp.ndarray:
+        """On-device dictionary decode for the pipelined scan: codes
+        [rows, cap] (uint8/uint16 wire dtype) + lut [n_values] →
+        out[r, i] = lut[codes[r, i]].  Low-NDV columns cross the wire
+        as 1-2 byte codes plus the tiny LUT instead of decoded
+        float32."""
+        rows, cap = codes.shape
+        nv = lut.shape[0]
+        l_pad = _round_up(max(nv, 128), 128)
+        lut2 = jnp.zeros((1, l_pad), lut.dtype).at[0, :nv].set(lut)
+        cap_pad = _round_up(max(cap, PROBE_CHUNK), PROBE_CHUNK)
+        c = codes.astype(jnp.int32)
+        if cap_pad != cap:
+            c = jnp.zeros((rows, cap_pad), jnp.int32).at[:, :cap].set(c)
+        out = pl.pallas_call(
+            _dictdecode_kernel,
+            grid=(rows, cap_pad // PROBE_CHUNK),
+            in_specs=[
+                pl.BlockSpec((1, l_pad), lambda i, j: (0, 0)),
+                pl.BlockSpec((1, PROBE_CHUNK), lambda i, j: (i, j)),
+            ],
+            out_specs=pl.BlockSpec((1, PROBE_CHUNK),
+                                   lambda i, j: (i, j)),
+            out_shape=jax.ShapeDtypeStruct((rows, cap_pad), lut.dtype),
+            interpret=interpret,
+        )(lut2, c)
+        return out[:, :cap]
+
+
+def bit_unpack_reference(packed: np.ndarray, cap: int) -> np.ndarray:
+    """numpy oracle for the bit unpack."""
+    p = np.asarray(packed)
+    bits = np.unpackbits(p, axis=-1)
+    return bits[..., :cap].astype(bool)
+
+
+def dict_decode_reference(codes: np.ndarray, lut: np.ndarray
+                          ) -> np.ndarray:
+    """numpy oracle for the dictionary decode."""
+    return np.asarray(lut)[np.asarray(codes).astype(np.int64)]
 
 
 def groupby_sums_reference(loc2d: np.ndarray, stack: np.ndarray,

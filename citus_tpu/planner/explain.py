@@ -43,6 +43,9 @@ EXPLAIN_TAGS: dict[str, str] = {
     "bucketed probe": "VMEM-tiled hash-bucketed probe path",
     "bucketed group-by": "dense-grid bucketed aggregation path",
     "Chunks Skipped": "chunk groups pruned by min/max skip nodes",
+    "pipelined scan":
+        "feed built by the prefetch/decode/transfer pipeline "
+        "(executor/scanpipe.py; scan_pipeline=host|device)",
     "Streamed Execution": "scan ran via the batched stream pipeline",
     "Device Rows Scanned": "result-transfer volume in row slots",
     "Memory": "device-memory ledger + OOM degradation for this statement",
@@ -87,10 +90,23 @@ def format_plan(plan: QueryPlan, catalog: Catalog,
 
     enabled = (settings is None
                or settings.get("enable_fast_path_router"))
-    if enabled and fast_path_shape(plan, catalog):
+    fast = enabled and fast_path_shape(plan, catalog)
+    if fast:
         lines.append(f"  {explain_tag('Fast Path Router')}: "
                      "single-shard host execution "
                      "(below fast_path_max_rows)")
+    elif settings is not None:
+        from ..executor.feed import walk_plan
+        from ..executor.scanpipe import resolve_scan_mode
+
+        mode = resolve_scan_mode(settings)
+        if mode != "off" and any(isinstance(n, ScanNode)
+                                 for n in walk_plan(plan.root)):
+            # plan-level: feeds build through the prefetch/decode/
+            # transfer pipeline.  Tiny scans (under the 'auto' row
+            # floor) and overlay-touching tables still read eagerly —
+            # a per-feed decision this shape-level line cannot see.
+            lines.append(f"  {explain_tag('pipelined scan')}: {mode}")
     _format_node(plan.root, lines, 1, catalog, settings)
     return lines
 

@@ -35,6 +35,12 @@ INSERT_SELECT_REPARTITION = "insert_select_repartition"
 INSERT_SELECT_PULL = "insert_select_pull"
 CHUNKS_SKIPPED = "chunks_skipped"
 QUERIES_STREAMED = "queries_streamed"
+# pipelined columnar scan (executor/scanpipe.py): chunk groups decoded
+# ahead by the prefetch producer, consumer waits on an empty prefetch
+# queue (pipeline underruns), bytes expanded by on-device decode
+CHUNKS_PREFETCHED_TOTAL = "chunks_prefetched_total"
+PREFETCH_STALLS_TOTAL = "prefetch_stalls_total"
+DEVICE_DECODED_BYTES_TOTAL = "device_decoded_bytes_total"
 # statements whose plan executed the bucketed dense-grid group-by
 # (ops/groupby.py) instead of the sort path
 GROUPBY_BUCKETED_TOTAL = "groupby_bucketed_total"
@@ -79,6 +85,8 @@ ALL_COUNTERS = [
     CAPACITY_RETRIES, DEVICE_ROWS_SCANNED,
     INSERT_SELECT_PUSHDOWN, INSERT_SELECT_REPARTITION, INSERT_SELECT_PULL,
     CHUNKS_SKIPPED, QUERIES_STREAMED, GROUPBY_BUCKETED_TOTAL,
+    CHUNKS_PREFETCHED_TOTAL, PREFETCH_STALLS_TOTAL,
+    DEVICE_DECODED_BYTES_TOTAL,
     RETRIES_TOTAL, FAILOVERS_TOTAL, TIMEOUTS_TOTAL, QUERIES_CANCELED,
     FAULTS_INJECTED_TOTAL,
     WLM_ADMITTED_TOTAL, WLM_QUEUED_TOTAL, WLM_SHED_TOTAL,

@@ -73,7 +73,11 @@ def decompress(data: bytes, cid: int, raw_size: int) -> bytes:
     if cid == CODEC_NONE:
         return data
     if cid == CODEC_ZLIB:
-        out = zlib.decompress(data)
+        # bufsize hint: chunk sizes are known exactly (vrlen/nrlen in
+        # the skip node), so the decompressor allocates once instead of
+        # growing through doubling reallocs — the Python fallback leg
+        # of the scan pipeline's hot decode loop
+        out = zlib.decompress(data, bufsize=max(raw_size, 64))
     elif cid == CODEC_ZSTD:
         if not _HAVE_ZSTD:
             raise StorageError("zstd codec unavailable")

@@ -313,10 +313,17 @@ class StripeReader:
                 if chunk_filter(self.chunk_stats(i, columns))]
 
     def read(self, columns: list[str] | None = None, chunk_filter=None,
+             chunks: list[int] | None = None,
              ) -> tuple[dict[str, np.ndarray], dict[str, np.ndarray], int]:
         """Read (and concatenate) selected chunks of the projected columns.
 
         Returns (values, validity, row_count_read).
+
+        `chunks` overrides skip-node selection with an explicit chunk
+        list — the pipelined scan path (executor/scanpipe.py) reads one
+        column at a time and must pin every column of a stripe to the
+        chunk set selected ONCE over the full projection's stats (a
+        per-column re-selection could disagree and misalign rows).
 
         The hot path is the native C++ codec (native/stripecodec.cpp):
         each chunk decompresses straight into its row offset of ONE
@@ -330,7 +337,8 @@ class StripeReader:
             if name not in self._by_name:
                 raise StorageError(f"{self.path}: no column {name!r}")
         cid = self.footer["codec"]
-        chunks = self.selected_chunks(columns, chunk_filter)
+        if chunks is None:
+            chunks = self.selected_chunks(columns, chunk_filter)
         native = self._read_native(columns, chunks, cid)
         if native is not None:
             return native

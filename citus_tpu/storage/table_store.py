@@ -137,8 +137,28 @@ class TableStore:
         # stripe/mask files exist but stay invisible (clean retry)
         fault_point("storage.manifest_flip")
         os.makedirs(self.table_dir(table), exist_ok=True)
-        dio.atomic_write_json_checked(self._manifest_path(table),
-                                      self._manifests[table])
+        path = self._manifest_path(table)
+        try:
+            prev_mtime = os.stat(path).st_mtime_ns
+        except OSError:
+            prev_mtime = None
+        dio.atomic_write_json_checked(path, self._manifests[table])
+        if prev_mtime is not None:
+            # identity must change on EVERY commit: two same-size
+            # commits inside one filesystem timestamp tick (easy once
+            # warm DML lands back-to-back) plus inode reuse would give
+            # the new manifest the exact (mtime_ns, size, inode) a
+            # reader session already cached — refresh_if_stale (and the
+            # serving cache's manifest-identity backstop) would serve
+            # the OLD rows.  Forcing mtime_ns strictly monotone along
+            # the commit chain makes the stat identity injective; we
+            # hold the table write lock, so the bump cannot race
+            # another writer.
+            try:
+                if os.stat(path).st_mtime_ns <= prev_mtime:
+                    os.utime(path, ns=(prev_mtime + 1, prev_mtime + 1))
+            except OSError:
+                pass
         with self._lock:
             self._record_manifest_stat(table)
 

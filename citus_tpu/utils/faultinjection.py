@@ -75,6 +75,11 @@ FAULT_POINTS: dict[str, str] = {
     "executor.agg_bucket_fill":
         "executor/compiler.py — bucketed group-by pack",
     "executor.device_put": "executor/feed.py — host→HBM placement",
+    "executor.scan_prefetch":
+        "executor/scanpipe.py — pipelined-scan prefetch/decode producer "
+        "(a death mid-prefetch must drain the pipeline cleanly)",
+    "executor.device_decode":
+        "executor/scanpipe.py — on-device decode of a wire payload",
     "executor.hbm_exhausted":
         "executor/hbm.py — accounted placement seam (arm with "
         "error='oom' for a synthetic allocator RESOURCE_EXHAUSTED)",

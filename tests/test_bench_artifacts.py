@@ -189,3 +189,47 @@ def test_readme_serving_multiplier_matches_artifact(artifact):
     assert quoted.group(1) == want, (
         f"README quotes {quoted.group(1)}× but the artifact says "
         f"{want}×")
+
+
+def test_readme_pipelined_scan_claims_match_artifact(artifact):
+    """The pipelined-scan section may only quote driver-stamped numbers
+    (the pipelined-vs-eager multiplier, the transfer wall share, the
+    bytes-on-wire ratio) when the newest artifact actually carries the
+    new scan keys — and then it must quote THOSE values (same honesty
+    contract as the serving/memory-pressure sections)."""
+    with open(os.path.join(ROOT, "README.md")) as f:
+        text = f.read()
+    q_ab = re.search(
+        r"(\d+(?:\.\d+)?)× the eager cold scan \(driver", text)
+    q_share = re.search(
+        r"transfer wall share (\d+(?:\.\d+)?)% \(driver", text)
+    q_wire = re.search(
+        r"(\d+(?:\.\d+)?)% of the decoded bytes cross the wire "
+        r"\(driver", text)
+    metrics = _artifact_metrics(artifact)
+    line = metrics.get("columnar_scan_gb_per_sec")
+    eager = metrics.get("columnar_scan_gb_per_sec_eager")
+    has_pipeline_keys = (line is not None and eager is not None
+                         and "wire_ratio" in line
+                         and line.get("scan_pipeline") not in (None,
+                                                               "off"))
+    if not has_pipeline_keys:
+        assert q_ab is None and q_share is None and q_wire is None, (
+            "README quotes driver-stamped pipelined-scan numbers but "
+            f"{os.path.basename(artifact)} has no pipelined scan "
+            "capture (phase keys missing)")
+        return
+    want_ab = f"{line['value'] / eager['value']:.1f}"
+    assert q_ab is not None and q_ab.group(1) == want_ab, (
+        f"README pipelined-vs-eager multiplier must quote {want_ab}× "
+        f"from {os.path.basename(artifact)} (got "
+        f"{q_ab.group(1) if q_ab else None})")
+    want_share = f"{line['transfer_wall_share'] * 100:g}"
+    assert q_share is not None and q_share.group(1) == want_share, (
+        f"README transfer wall share must quote {want_share}% from "
+        f"{os.path.basename(artifact)}")
+    if q_wire is not None and line.get("wire_ratio") is not None:
+        assert q_wire.group(1) == f"{line['wire_ratio'] * 100:g}", (
+            f"README wire ratio must quote "
+            f"{line['wire_ratio'] * 100:g}% from "
+            f"{os.path.basename(artifact)}")

@@ -82,6 +82,13 @@ FAULT_POOL = [
     # clean ResourceExhausted — never a dead process or wrong rows
     dict(name="executor.hbm_exhausted", error="oom"),
     dict(name="executor.hbm_exhausted", error="oom", p=0.5, times=2),
+    # pipelined-scan seams (PR 11): a death on the prefetch/decode
+    # producer (or while expanding a wire payload on-device) must drain
+    # the pipeline into answered-XOR-errored with zero leaked
+    # prefetch-category HBM charges — asserted post-soak below
+    dict(name="executor.scan_prefetch"),
+    dict(name="executor.scan_prefetch", p=0.5, times=2),
+    dict(name="executor.device_decode"),
 ]
 
 
@@ -119,11 +126,17 @@ def _run_soak(tmp_path, n_ops: int, seed: int, fault_rate: float):
 def _run_soak_inner(tmp_path, n_ops: int, seed: int, fault_rate: float):
     rng = random.Random(seed)
     data_dir = str(tmp_path / "chaos")
-    mk = lambda: citus_tpu.connect(  # noqa: E731
+    mk = lambda **kw: citus_tpu.connect(  # noqa: E731
         data_dir=data_dir, n_devices=2, retry_backoff_base_ms=1,
         retry_backoff_max_ms=5, max_statement_retries=2,
-        shard_replication_factor=2, max_concurrent_statements=2)
-    sessions = [mk(), mk(), mk()]
+        shard_replication_factor=2, max_concurrent_statements=2,
+        **kw)
+    # one session per scan_pipeline mode: the soak's mixed workload must
+    # hold the oracle invariant on the eager path, the host pipeline AND
+    # the on-device-decode pipeline concurrently (forced modes engage
+    # regardless of table size, so the new fault seams actually fire)
+    sessions = [mk(scan_pipeline="off"), mk(scan_pipeline="host"),
+                mk(scan_pipeline="device")]
     s0 = sessions[0]
     s0.execute("CREATE TABLE kv (id INT, v INT)")
     s0.execute("SELECT create_distributed_table('kv', 'id', 4)")
@@ -231,6 +244,17 @@ def _run_soak_inner(tmp_path, n_ops: int, seed: int, fault_rate: float):
         b["answered_total"] + b["errored_total"]
         + b["fallback_total"]), b
     assert b["queue_depth"] == 0 and not b["leader_active"], b
+    # the pipelined scan leaked nothing: every prefetch-category HBM
+    # charge released when its pipeline finished, shed, or died on an
+    # armed fault (the PR-10 zero-leak ledger, extended to prefetch)
+    import gc
+
+    from citus_tpu.executor.hbm import accountant_for
+
+    acc = accountant_for(data_dir)
+    if acc.live_bytes("prefetch"):
+        gc.collect()  # traceback-pinned payloads release at collection
+    assert acc.live_bytes("prefetch") == 0, acc.snapshot()
     for sess in sessions:
         sess.close()
     fresh.close()

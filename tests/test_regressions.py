@@ -673,3 +673,76 @@ def test_stripe_split_hash_path_discards_partial_on_fault(tmp_path):
     s.execute(f"COPY h FROM '{csv}' WITH (FORMAT csv)")
     assert int(s.execute("SELECT count(*) FROM h").rows()[0][0]) == 6000
     s.close()
+
+
+def test_feed_cache_keys_on_skip_filter_fingerprint(tmp_path):
+    """A skip-pruned (possibly prefetched) feed must never be served to
+    a statement with a different chunk filter: the feed-cache key
+    carries the storage-name-mapped skip-test fingerprint, so two
+    filters that read different chunk sets get different slots — and a
+    repeat of the SAME filter still hits."""
+    sess = citus_tpu.connect(data_dir=str(tmp_path / "fc"), n_devices=2,
+                             serving_result_cache_bytes=0,
+                             scan_pipeline="host")
+    sess.execute("CREATE TABLE ranges (id INT, v INT)")
+    sess.execute("SELECT create_distributed_table('ranges', 'id', 2)")
+    # two value bands in separate stripes per shard, so min/max skip
+    # nodes actually prune: filter A reads only band 1, filter B only
+    # band 2.  A key that ignored the filter would serve band-1 rows
+    # to the band-2 statement.
+    sess.execute("INSERT INTO ranges VALUES " + ", ".join(
+        f"({i}, {i})" for i in range(1000)))
+    sess.execute("INSERT INTO ranges VALUES " + ", ".join(
+        f"({i}, {i})" for i in range(100000, 101000)))
+    lo = sess.execute(
+        "SELECT count(*), min(v), max(v) FROM ranges WHERE v < 1000"
+    ).rows()
+    assert lo == [(1000, 0, 999)]
+    hi = sess.execute(
+        "SELECT count(*), min(v), max(v) FROM ranges "
+        "WHERE v >= 100000").rows()
+    assert hi == [(1000, 100000, 100999)]
+    # same filter again: the pruned feed is reusable — and must hit
+    h0 = sess.executor.feed_cache.hits
+    again = sess.execute(
+        "SELECT count(*), min(v), max(v) FROM ranges "
+        "WHERE v >= 100000").rows()
+    assert again == hi
+    assert sess.executor.feed_cache.hits > h0
+    # a rename must not alias the fingerprint either (the key maps
+    # current names to the storage names the chunk filter tested)
+    sess.execute("ALTER TABLE ranges RENAME COLUMN v TO w")
+    renamed = sess.execute(
+        "SELECT count(*) FROM ranges WHERE w < 1000").rows()
+    assert renamed == [(1000,)]
+    sess.close()
+
+
+def test_manifest_identity_strictly_monotone(tmp_path):
+    """Cross-session visibility keys on the manifest's stat identity
+    (mtime_ns, size, inode).  Two same-size commits inside one
+    filesystem timestamp tick (warm DML lands back-to-back) could
+    reissue an identity a reader already cached — refresh_if_stale
+    would serve the old rows.  The writer now forces mtime_ns strictly
+    monotone along the commit chain; simulate the colliding tick by
+    pushing the current manifest's mtime a second into the future and
+    committing again."""
+    import os
+
+    sess = citus_tpu.connect(data_dir=str(tmp_path / "mono"),
+                             n_devices=2)
+    sess.execute("CREATE TABLE kv (id INT, v INT)")
+    sess.execute("SELECT create_distributed_table('kv', 'id', 2)")
+    sess.execute("INSERT INTO kv VALUES (1, 10), (2, 20)")
+    path = sess.store._manifest_path("kv")
+    st1 = os.stat(path).st_mtime_ns
+    future = st1 + 10 ** 9
+    os.utime(path, ns=(future, future))
+    sess.execute("UPDATE kv SET v = 11 WHERE id = 1")
+    st2 = os.stat(path).st_mtime_ns
+    assert st2 > future, (st2, future)
+    # and a second session actually sees the write
+    s2 = citus_tpu.connect(data_dir=str(tmp_path / "mono"), n_devices=2)
+    assert s2.execute("SELECT v FROM kv WHERE id = 1").rows() == [(11,)]
+    sess.close()
+    s2.close()
