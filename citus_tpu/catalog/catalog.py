@@ -376,6 +376,24 @@ class Catalog:
         return sorted((n for n in self.nodes.values() if n.is_active),
                       key=lambda n: n.node_id)
 
+    def node_device_map(self, n_devices: int) -> dict[int, int]:
+        """Explicit node_id → mesh-device-index map — THE catalog fact
+        feed placement, the planner and the WLM budget estimator all
+        route through (planner/plan.py table_placement).
+
+        Active nodes ranked by node_id take devices round-robin, so
+        the map survives node removals and late additions without
+        aliasing: the old ``(node_id - 1) % n_devices`` fold mapped a
+        node added after a removal onto an already-occupied device
+        while the removed node's device sat idle.  More active nodes
+        than devices still folds (a mesh slot hosts several logical
+        nodes — the 1-device test mesh runs every node); fewer leaves
+        trailing devices empty until citus_rebalance_mesh() grows the
+        node set (operations/rebalancer.py)."""
+        with self._lock:
+            return {n.node_id: i % max(1, n_devices)
+                    for i, n in enumerate(self.active_nodes())}
+
     # -- colocation --------------------------------------------------------
     def get_or_create_colocation_group(
             self, shard_count: int, dtype: DataType | None) -> ColocationGroup:

@@ -58,6 +58,16 @@ _register(ConfigVar(
     "(ref: citus.shard_replication_factor, shared_library_init.c).",
     int, min_value=1, max_value=64))
 
+_register(ConfigVar(
+    "mesh_devices", 0,
+    "Mesh width for new sessions that pass no explicit n_devices: use "
+    "this many devices of the backend (0 = every visible device).  The "
+    "catalog's node↔device map folds logical nodes onto the mesh "
+    "(catalog.node_device_map); citus_rebalance_mesh() grows the node "
+    "set onto a wider mesh.  No reference equivalent — the cluster size "
+    "there is the worker node list (pg_dist_node).",
+    int, min_value=0, max_value=4096))
+
 # --- executor -------------------------------------------------------------
 _register(ConfigVar(
     "enable_repartition_joins", True,

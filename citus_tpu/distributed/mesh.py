@@ -36,5 +36,27 @@ def put_sharded(mesh: Mesh, arr: np.ndarray) -> jax.Array:
     return jax.device_put(arr, NamedSharding(mesh, P(SHARD_AXIS)))
 
 
+def put_sharded_slices(mesh: Mesh, slices) -> jax.Array:
+    """Per-device host slices → ONE mesh-sharded [n_dev, ...] array.
+
+    The device-owned feed path: each device's slice (built from only
+    the shards that device owns) transfers independently — N
+    dispatches an N-device mesh absorbs in parallel instead of one
+    host-side [n_dev, ...] concat pushed through a single device_put.
+    The assembled global array carries NamedSharding(P(SHARD_AXIS)),
+    indistinguishable to the compiled program from a put_sharded feed.
+    """
+    devs = list(mesh.devices.flat)
+    if len(slices) != len(devs):
+        raise ValueError(
+            f"need one slice per device: {len(slices)} != {len(devs)}")
+    sharding = NamedSharding(mesh, P(SHARD_AXIS))
+    bufs = [jax.device_put(s[None, ...], d)
+            for s, d in zip(slices, devs)]
+    global_shape = (len(devs),) + tuple(slices[0].shape)
+    return jax.make_array_from_single_device_arrays(
+        global_shape, sharding, bufs)
+
+
 def put_replicated(mesh: Mesh, arr: np.ndarray) -> jax.Array:
     return jax.device_put(arr, NamedSharding(mesh, P()))

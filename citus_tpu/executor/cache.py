@@ -178,6 +178,7 @@ class CachedFeed:
     valid: object
     capacity: int
     nbytes: int = 0
+    dev_rows: list | None = None  # per-device row counts (Mesh: line)
 
 
 class FeedCache:

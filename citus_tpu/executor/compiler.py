@@ -202,6 +202,9 @@ class FeedSpec:
     nulls: dict[str, np.ndarray]
     valid: np.ndarray                   # [n_dev, cap] or [cap]
     capacity: int
+    # rows each device owns (pre-padding; None for replicated feeds) —
+    # the EXPLAIN ANALYZE Mesh: line's per-device rows-in source
+    dev_rows: list[int] | None = None
 
 
 @dataclass
@@ -369,6 +372,11 @@ class PlanCompiler:
                 self._overflow = jnp.zeros((), dtype=jnp.int64)
                 self._dense_oob = jnp.zeros((), dtype=jnp.int64)
                 self._stage_actual = {}
+                # static all_to_all volume this program moves across
+                # the mesh — assigned (not accumulated across traces:
+                # eval_shape and the jit both trace this body) and
+                # published as PlanCompiler.shuffle_bytes after build
+                self._shuffle_bytes = 0
                 out = self._exec(self.plan.root, blocks)
                 if self.plan.output_repart is not None:
                     # INSERT..SELECT device routing: shuffle the final
@@ -420,6 +428,10 @@ class PlanCompiler:
                            check_vma=False)
         # abstract-eval to learn output dtypes, then build the pack plan
         shapes = jax.eval_shape(mapped, *feed_arrays)
+        # traced, not estimated: the repartition stages that actually
+        # exist in this program (the psum-directory pushdown compiles
+        # shuffles away entirely — a caps-table estimate would lie)
+        self.shuffle_bytes = int(self._shuffle_bytes)
         s_cols, s_nulls, s_valid, _ = shapes
         out_meta = []
         for cid in out_cids:
@@ -911,6 +923,13 @@ class PlanCompiler:
                 arr, SHARD_AXIS, split_axis=0, concat_axis=0, tiled=True)
         new_valid = jax.lax.all_to_all(
             pvalid, SHARD_AXIS, split_axis=0, concat_axis=0, tiled=True)
+        # mesh-wide exchange volume of this stage (each device moves its
+        # whole [n_dev, cap] pack) — static shapes make it knowable at
+        # trace time, surfaced via the Mesh: EXPLAIN line and
+        # shuffle_bytes_total
+        self._shuffle_bytes += self.n_dev * int(
+            sum(int(a.size) * a.dtype.itemsize for a in packed.values())
+            + int(pvalid.size) * pvalid.dtype.itemsize)
         flat_n = self.n_dev * capacity
         cols, nulls = {}, {}
         for cid, arr in exchanged.items():
@@ -1437,6 +1456,16 @@ class PlanCompiler:
             agg_side = ("left" if getattr(j, "build_side", "right")
                         == "right" else "right")
 
+        if j.strategy in ("repart_both", "repart_left", "repart_right"):
+            # shuffle-free variant: when the build key has a dense
+            # extent, a psum'd count directory replaces BOTH all_to_all
+            # repartitions — the worker-partial-aggregate move done
+            # mesh-natively (see _agg_pushdown_psum_directory)
+            pushed = self._agg_pushdown_psum_directory(node, j, agg_side,
+                                                       feeds)
+            if pushed is not None:
+                return pushed
+
         lblk, rblk, lkeys, lmatch, rkeys, rmatch = \
             self._join_inputs(j, feeds)
         if agg_side == "left":
@@ -1451,7 +1480,86 @@ class PlanCompiler:
         _order, lo, hi, dense_oob = _bounds(bkeys, bmatch, pkeys, dense)
         self._dense_oob = self._dense_oob + dense_oob.astype(jnp.int64)
         counts = jnp.where(pmatch, (hi - lo).astype(jnp.int64), 0)
+        return self._agg_from_match_counts(node, pblk, counts)
 
+    # psum'd count directories stay worthwhile while the collective
+    # volume (extent × 4 B, once per execution) is small next to the
+    # all_to_all volume it replaces (the whole input, twice); 4M slots
+    # = 16 MB over ICI is the break-even neighborhood on a v5e
+    PSUM_DIRECTORY_MAX_SLOTS = 1 << 22
+
+    def _agg_pushdown_psum_directory(self, node: AggregateNode, j,
+                                     agg_side: str, feeds):
+        """Global aggregate over a REPARTITION join without any
+        shuffle: each device scatter-adds its local build rows into a
+        [extent] count directory keyed by the dense join key, ONE psum
+        makes the directory global, and every probe row reads its
+        global match count locally.  The two all_to_all stages (and
+        their pack sorts — the dominant cost of the dual-repartition
+        shape) vanish; what crosses the mesh is extent × 4 bytes.
+        Returns None when ineligible (multi-key join, no dense extent,
+        directory too wide) — the caller falls back to the repartition
+        pushdown, and a dense_oob retry (stale statistics) lands there
+        too via caps.dense_off."""
+        if self.caps.dense_off:
+            return None
+        if len(j.left_keys) != 1 or len(j.right_keys) != 1:
+            return None
+        extents = (getattr(j, "right_key_extents", ())
+                   if agg_side == "left"
+                   else getattr(j, "left_key_extents", ()))
+        if not extents or extents[0] is None:
+            return None
+        base, extent = int(extents[0][0]), int(extents[0][1])
+        if not (0 < extent + 1 <= self.PSUM_DIRECTORY_MAX_SLOTS):
+            return None
+
+        lblk = self._exec(j.left, feeds)
+        rblk = self._exec(j.right, feeds)
+        key_int32 = getattr(j, "key_int32", ())
+        lkeys, lmatch = self._eval_keys(lblk, j.left_keys, key_int32)
+        rkeys, rmatch = self._eval_keys(rblk, j.right_keys, key_int32)
+        if j.left_match_filter is not None:
+            lmatch = lmatch & predicate_mask(j.left_match_filter,
+                                             _src(lblk), jnp)
+        if j.right_match_filter is not None:
+            rmatch = rmatch & predicate_mask(j.right_match_filter,
+                                             _src(rblk), jnp)
+        if agg_side == "left":
+            pblk, pkeys, pmatch = lblk, lkeys, lmatch
+            bkeys, bmatch = rkeys, rmatch
+        else:
+            pblk, pkeys, pmatch = rblk, rkeys, rmatch
+            bkeys, bmatch = lkeys, lmatch
+
+        # build-side rows outside the planned extent would silently
+        # miss the directory — count them into dense_oob so stale
+        # statistics recompile on the repartition path.  Probe-side
+        # out-of-extent keys simply match nothing (exact, no retry).
+        raw_b = bkeys[0].astype(jnp.int64) - jnp.int64(base)
+        b_in = (raw_b >= 0) & (raw_b < extent)
+        self._dense_oob = self._dense_oob + \
+            (bmatch & ~b_in).sum().astype(jnp.int64)
+        idx = jnp.where(bmatch & b_in, raw_b,
+                        jnp.int64(extent)).astype(jnp.int32)
+        dirc = jnp.zeros(extent + 1, jnp.int32).at[idx].add(
+            jnp.int32(1), mode="drop")[:extent]
+        dirc = jax.lax.psum(dirc, SHARD_AXIS)
+        raw_p = pkeys[0].astype(jnp.int64) - jnp.int64(base)
+        p_in = (raw_p >= 0) & (raw_p < extent)
+        pidx = jnp.clip(raw_p, 0, extent - 1).astype(jnp.int32)
+        counts = jnp.where(pmatch & p_in, dirc[pidx],
+                           jnp.int32(0)).astype(jnp.int64)
+        return self._agg_from_match_counts(node, pblk, counts,
+                                           counts_global=True)
+
+    def _agg_from_match_counts(self, node: AggregateNode, pblk: Block,
+                               counts, counts_global: bool = False):
+        """Finish an aggregate pushdown from per-probe-row match
+        counts.  `counts_global=True` ⇒ counts already include every
+        device's build rows (the psum-directory path) — the cross-
+        device combine over PROBE rows is identical either way, since
+        each probe row lives on exactly one device."""
         values = self._agg_values(node, pblk)
         cols, nulls = {}, {}
         for (a, cid), (v, kind, vv) in zip(node.aggs, values):

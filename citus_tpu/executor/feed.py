@@ -199,12 +199,13 @@ def _feed_scan_cached(node: ScanNode, catalog: Catalog, store: TableStore,
                      + list(spec.nulls.values()) + [spec.valid])
         entry = CachedFeed(sharded=spec.sharded, arrays=spec.arrays,
                            nulls=spec.nulls, valid=spec.valid,
-                           capacity=spec.capacity, nbytes=nbytes)
+                           capacity=spec.capacity, nbytes=nbytes,
+                           dev_rows=spec.dev_rows)
         cache.put(key, entry)
         return spec
     return FeedSpec(node=node, sharded=entry.sharded, arrays=entry.arrays,
                     nulls=entry.nulls, valid=entry.valid,
-                    capacity=entry.capacity)
+                    capacity=entry.capacity, dev_rows=entry.dev_rows)
 
 
 def _feed_scan(node: ScanNode, catalog: Catalog, store: TableStore,
@@ -235,6 +236,11 @@ def _feed_scan(node: ScanNode, catalog: Catalog, store: TableStore,
         chunk_filter = make_chunk_filter(node.filter, counters, name_map)
 
     if meta.method == DistributionMethod.HASH:
+        # device-owned assembly: each device's slice is built from ONLY
+        # the shards the catalog's node↔device map assigns it, as an
+        # independent [cap] buffer — never one [n_dev, cap] host concat.
+        # Placement below transfers the slices individually, so an
+        # N-device mesh absorbs N dispatches in parallel.
         per_dev_vals: list[dict[str, list[np.ndarray]]] = [
             {c: [] for c in colnames} for _ in range(n_dev)]
         per_dev_mask: list[dict[str, list[np.ndarray]]] = [
@@ -261,25 +267,32 @@ def _feed_scan(node: ScanNode, catalog: Catalog, store: TableStore,
             dtype = rel.schema.column(cname).dtype.numpy_dtype
             if dtype == np.float64 and compute_dtype is not None:
                 dtype = np.dtype(compute_dtype)
-            buf = np.zeros((n_dev, cap), dtype=dtype)
-            nbuf = np.zeros((n_dev, cap), dtype=bool)
+            slices = []
+            nslices = []
             has_nulls = False
             for d in range(n_dev):
+                sl = np.zeros(cap, dtype=dtype)
+                nsl = np.zeros(cap, dtype=bool)
                 if per_dev_vals[d][cname]:
                     v = np.concatenate(per_dev_vals[d][cname]).astype(dtype)
                     m = np.concatenate(per_dev_mask[d][cname])
-                    buf[d, :len(v)] = v
+                    sl[:len(v)] = v
                     if not m.all():
                         has_nulls = True
-                        nbuf[d, :len(m)] = ~m
-            arrays[cid] = buf
+                        nsl[:len(m)] = ~m
+                slices.append(sl)
+                nslices.append(nsl)
+            arrays[cid] = slices
             if has_nulls:
-                nulls[cid] = nbuf
-        valid = np.zeros((n_dev, cap), dtype=bool)
+                nulls[cid] = nslices
+        valid = []
         for d in range(n_dev):
-            valid[d, :per_dev_rows[d]] = True
+            vsl = np.zeros(cap, dtype=bool)
+            vsl[:per_dev_rows[d]] = True
+            valid.append(vsl)
         feed = FeedSpec(node=node, sharded=True, arrays=arrays, nulls=nulls,
-                        valid=valid, capacity=cap)
+                        valid=valid, capacity=cap,
+                        dev_rows=list(per_dev_rows))
     else:
         # reference/local: single shard replicated to every device
         if len(shards) != 1:
@@ -318,7 +331,12 @@ def _feed_scan(node: ScanNode, catalog: Catalog, store: TableStore,
         else accountant
 
     def put(a):
-        return acc.place(mesh, a, feed.sharded, category)
+        # sharded feeds arrive as per-device slice lists (device-owned
+        # path: independent per-device transfers through the slice
+        # seam, charged per device); replicated feeds as one host array
+        if feed.sharded:
+            return acc.place_sharded_slices(mesh, a, category)
+        return acc.place(mesh, a, False, category)
 
     feed.arrays = {c: put(a) for c, a in feed.arrays.items()}
     feed.nulls = {c: put(a) for c, a in feed.nulls.items()}

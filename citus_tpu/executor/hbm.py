@@ -106,10 +106,20 @@ class DeviceMemoryAccountant:
         # handle's entry)
         self._mu = threading.RLock()
         self._next_handle = 0
-        # handle → (category, per-device bytes)
-        self._live: dict[int, tuple[str, int]] = {}
+        # handle → (category, per-device bytes, applied per-device
+        # vector) — the vector is what _release subtracts, so a charge
+        # recorded under one mesh size releases exactly what it added
+        self._live: dict[int, tuple[str, int, tuple[int, ...]]] = {}
         self._live_total = 0
         self._live_by_cat: dict[str, int] = {c: 0 for c in CATEGORIES}
+        # measured live bytes PER DEVICE index: uniform charges (whole
+        # sharded/replicated arrays, leases) apply their per-device
+        # figure to every device of the last-seen mesh; the slice seam
+        # (place_sharded_slices) applies each device's actual slice
+        # bytes.  Budget enforcement is against the HOTTEST device —
+        # one hot device OOMs regardless of cluster-wide headroom.
+        self._live_by_dev: list[int] = [0]
+        self._n_dev = 1
         self.peak_bytes = 0
         self.charges_total = 0
         self.releases_total = 0
@@ -145,6 +155,7 @@ class DeviceMemoryAccountant:
         # statement error, never a partially placed feed
         fault_point("executor.hbm_exhausted")
         n_dev = mesh.devices.size
+        self._note_mesh(n_dev)
         nbytes = (int(arr.nbytes) if not sharded or n_dev <= 0
                   else -(-int(arr.nbytes) // n_dev))
         handle = self._charge(category, nbytes)
@@ -163,6 +174,54 @@ class DeviceMemoryAccountant:
         weakref.finalize(out, self._release, handle)
         return out, handle
 
+    def place_sharded_slices(self, mesh, slices,
+                             category: str = "feed"):
+        """Place per-device host slices as ONE mesh-sharded array
+        (distributed/mesh.py put_sharded_slices) — the device-owned
+        feed seam: each device's transfer dispatches independently and
+        the ledger charges each device its OWN slice bytes, so a
+        skew-placed table (every shard on one node of a grown mesh)
+        shows up as the hot-device pressure it really is."""
+        out, _handle = self.place_sharded_slices_tracked(mesh, slices,
+                                                         category)
+        return out
+
+    def place_sharded_slices_tracked(self, mesh, slices,
+                                     category: str = "feed"):
+        from ..distributed.mesh import put_sharded_slices
+        from ..utils.faultinjection import fault_point
+
+        # same named seam/classification contract as place_tracked
+        fault_point("executor.hbm_exhausted")
+        self._note_mesh(mesh.devices.size)
+        per_dev = tuple(int(s.nbytes) for s in slices)
+        nbytes = max(per_dev) if per_dev else 0
+        handle = self._charge(category, nbytes, per_dev=per_dev)
+        try:
+            out = put_sharded_slices(mesh, slices)
+        except Exception as e:
+            self._release(handle)
+            if is_resource_exhausted(e):
+                self._count_oom()
+                err = DeviceMemoryExhausted(
+                    f"device allocator OOM placing {nbytes} bytes on "
+                    f"the hottest device (category {category!r}): {e}")
+                err.nbytes = nbytes
+                raise err from e
+            raise
+        weakref.finalize(out, self._release, handle)
+        return out, handle
+
+    def _note_mesh(self, n_dev: int) -> None:
+        """Learn the mesh width so uniform charges span every device."""
+        n = max(1, int(n_dev))
+        with self._mu:
+            if n > self._n_dev:
+                self._n_dev = n
+            if n > len(self._live_by_dev):
+                self._live_by_dev.extend(
+                    [0] * (n - len(self._live_by_dev)))
+
     def recharge(self, handle: int, category: str) -> None:
         """Move a live charge to another category (pipelined feed
         columns graduate prefetch → feed/cache on adoption).  A handle
@@ -174,10 +233,10 @@ class DeviceMemoryAccountant:
             entry = self._live.get(handle)
             if entry is None:
                 return
-            old_cat, nbytes = entry
+            old_cat, nbytes, per_dev = entry
             if old_cat == category:
                 return
-            self._live[handle] = (category, nbytes)
+            self._live[handle] = (category, nbytes, per_dev)
             self._live_by_cat[old_cat] -= nbytes
             self._live_by_cat[category] += nbytes
 
@@ -207,24 +266,38 @@ class DeviceMemoryAccountant:
             self._release(handle)
 
     # -- ledger ------------------------------------------------------------
-    def _charge(self, category: str, nbytes: int) -> int:
+    def _charge(self, category: str, nbytes: int,
+                per_dev: tuple[int, ...] | None = None) -> int:
         if category not in CATEGORIES:
             category = "other"
         with self._mu:
+            # the applied per-device vector: uniform charges put their
+            # per-device figure on every device of the known mesh
+            applied = (per_dev if per_dev is not None
+                       else (nbytes,) * self._n_dev)
+            if len(applied) > len(self._live_by_dev):
+                self._live_by_dev.extend(
+                    [0] * (len(applied) - len(self._live_by_dev)))
             sim = self._sim
             if sim is not None:
                 sim.allocs += 1
                 sim.journal.append((sim.allocs, category, nbytes))
                 fail = (sim.fail_at is not None
                         and sim.allocs == sim.fail_at)
-                over = (sim.budget is not None
-                        and self._live_total + nbytes > sim.budget)
+                # per-device enforcement: the budget is a PER-DEVICE
+                # ceiling, so the check is against the hottest device's
+                # prospective load — cluster-wide headroom does not
+                # save a device whose own slice no longer fits
+                hot = max(self._live_by_dev[d] + b
+                          for d, b in enumerate(applied)) \
+                    if applied else nbytes
+                over = (sim.budget is not None and hot > sim.budget)
                 if fail or over:
                     sim.oom_raised += 1
                     self.oom_total += 1
                     why = (f"armed at allocation {sim.fail_at}" if fail
                            else f"budget {sim.budget} bytes/device, "
-                                f"{self._live_total} live")
+                                f"hottest device would reach {hot}")
                     err = DeviceMemoryExhausted(
                         f"{_OOM_TOKEN} (MemSim): allocation "
                         f"{sim.allocs} of {nbytes} bytes/device "
@@ -233,9 +306,11 @@ class DeviceMemoryAccountant:
                     raise err
             self._next_handle += 1
             handle = self._next_handle
-            self._live[handle] = (category, nbytes)
+            self._live[handle] = (category, nbytes, applied)
             self._live_total += nbytes
             self._live_by_cat[category] += nbytes
+            for d, b in enumerate(applied):
+                self._live_by_dev[d] += b
             self.charges_total += 1
             if self._live_total > self.peak_bytes:
                 self.peak_bytes = self._live_total
@@ -246,9 +321,11 @@ class DeviceMemoryAccountant:
             entry = self._live.pop(handle, None)
             if entry is None:
                 return
-            category, nbytes = entry
+            category, nbytes, applied = entry
             self._live_total -= nbytes
             self._live_by_cat[category] -= nbytes
+            for d, b in enumerate(applied):
+                self._live_by_dev[d] -= b
             self.releases_total += 1
 
     def _count_oom(self) -> None:
@@ -265,6 +342,13 @@ class DeviceMemoryAccountant:
         with self._mu:
             return (self._live_total if category is None
                     else self._live_by_cat.get(category, 0))
+
+    def live_bytes_by_device(self) -> list[int]:
+        """Measured live bytes per mesh-device index (uniform charges
+        span every device; slice placements charge each device its own
+        slice) — the hot-device view citus_stat_mesh() surfaces."""
+        with self._mu:
+            return list(self._live_by_dev[:self._n_dev])
 
     def transient_bytes(self) -> int:
         """Live bytes that should return to zero between statements —
@@ -331,6 +415,8 @@ class DeviceMemoryAccountant:
             sim = self._sim
             snap = {
                 "live_bytes": self._live_total,
+                "live_bytes_hot_device": max(
+                    self._live_by_dev[:self._n_dev], default=0),
                 "peak_bytes": self.peak_bytes,
                 "charges_total": self.charges_total,
                 "releases_total": self.releases_total,

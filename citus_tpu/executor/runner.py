@@ -90,6 +90,10 @@ class ResultSet:
     # execution metadata (EXPLAIN ANALYZE / stats counters read these)
     retries: int = 0
     device_rows_scanned: int = 0
+    # rows each mesh device fed INTO the program (per-device sums over
+    # the sharded scan feeds; None when unknown) — the Mesh: line's
+    # rows-in column
+    device_rows_in: list[int] | None = None
     fast_path: bool = False   # executed host-side via the fast-path router
     streamed_batches: int = 0  # >0 ⇒ executed via the stream pipeline
     spill_passes: int = 0     # >0 ⇒ executed via multi-pass partitioning
@@ -192,7 +196,7 @@ class Executor:
         if streamed is not None:
             return streamed
         compute_dtype = np.dtype(self.settings.get("compute_dtype"))
-        packed, out_meta, caps, retries = self._run_resident(
+        packed, out_meta, caps, retries, feeds = self._run_resident(
             plan, compute_dtype)
         self.count_groupby_bucketed(plan, caps)
         cols, nulls, valid = unpack_outputs(packed, out_meta)
@@ -201,6 +205,7 @@ class Executor:
         # result-transfer volume in row slots (n_dev·cap, or n_dev·k under
         # device top-k pushdown) — EXPLAIN ANALYZE / stats surface this
         result.device_rows_scanned = int(np.asarray(valid).size)
+        result.device_rows_in = feed_device_rows(feeds, plan.n_devices)
         return result
 
     # ------------------------------------------------------------------
@@ -235,8 +240,9 @@ class Executor:
             memo = self._caps_memo.get(fingerprint)
         caps = (self._caps_from_order(plan, memo) if memo is not None
                 else self._initial_capacities(plan, feeds))
-        return self.run_with_retry(plan, feeds, caps, fingerprint,
-                                   compute_dtype)
+        packed, out_meta, caps, retries = self.run_with_retry(
+            plan, feeds, caps, fingerprint, compute_dtype)
+        return packed, out_meta, caps, retries, feeds
 
     # ------------------------------------------------------------------
     def execute_pass(self, plan: QueryPlan, split_nid: int):
@@ -258,7 +264,7 @@ class Executor:
                 self.count_groupby_bucketed(plan, caps)
             return parts, scanned, retries, batches
         compute_dtype = np.dtype(self.settings.get("compute_dtype"))
-        packed, out_meta, caps, retries = self._run_resident(
+        packed, out_meta, caps, retries, _feeds = self._run_resident(
             plan, compute_dtype, no_cache_nodes=frozenset({split_nid}))
         self.count_groupby_bucketed(plan, caps)
         cols, nulls, valid = unpack_outputs(packed, out_meta)
@@ -328,9 +334,11 @@ class Executor:
                                         probe_kernel=probe_kernel,
                                         group_kernel=group_kernel)
                 fn, feed_arrays, out_meta, stage_keys = compiler.build()
-                self.plan_cache.put(key, (fn, out_meta, stage_keys))
+                shuffle_bytes = compiler.shuffle_bytes
+                self.plan_cache.put(key, (fn, out_meta, stage_keys,
+                                          shuffle_bytes))
             else:
-                fn, out_meta, stage_keys = entry
+                fn, out_meta, stage_keys, shuffle_bytes = entry
                 feed_arrays = flatten_feed_arrays(plan, feeds,
                                                   compute_dtype)
             # two device→host transfers total: the bit-packed output block
@@ -391,6 +399,17 @@ class Executor:
                         continue  # recompile tight + re-execute
                 if retries or tightened:
                     self._memoize_caps(fingerprint, plan, caps)
+                if self.counters is not None and shuffle_bytes:
+                    # TRACED all_to_all volume of the converged
+                    # execution (PlanCompiler counts the exchange
+                    # stages that actually exist — the psum-directory
+                    # pushdown compiles shuffles away; stream paths
+                    # pass here per batch, so the counter scales with
+                    # what actually crossed the mesh)
+                    from ..stats import counters as sc
+
+                    self.counters.increment(sc.SHUFFLE_BYTES_TOTAL,
+                                            shuffle_bytes)
                 return packed, out_meta, caps, retries
             retries += 1
             from ..utils.faultinjection import fault_point
@@ -1036,7 +1055,8 @@ class Executor:
                 col = np.asarray(out_cols[c], dtype=object)
                 col[out_nulls[c]] = None
                 out_cols[c] = col
-        return ResultSet(names, out_cols, final_n, dtypes=out_dtypes)
+        return ResultSet(names, out_cols, final_n, dtypes=out_dtypes,
+                         device_rows=device_rows)
 
     @staticmethod
     def _unique_name(name: str, taken: list[str]) -> str:
@@ -1046,6 +1066,22 @@ class Executor:
         while f"{name}_{i}" in taken:
             i += 1
         return f"{name}_{i}"
+
+
+def feed_device_rows(feeds, n_dev: int) -> list[int] | None:
+    """Per-device rows-in across the sharded scan feeds (the Mesh:
+    line's input column); None when no feed carries per-device counts
+    (pure reference-table plans)."""
+    totals = [0] * n_dev
+    seen = False
+    for f in feeds.values():
+        dr = getattr(f, "dev_rows", None)
+        if dr is None:
+            continue
+        seen = True
+        for d, r in enumerate(dr[:n_dev]):
+            totals[d] += int(r)
+    return totals if seen else None
 
 
 def _plan_buffer_bytes(plan: QueryPlan, caps: Capacities) -> int:

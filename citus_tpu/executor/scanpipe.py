@@ -84,17 +84,32 @@ class ScanPhaseStats:
         with self._mu:
             for f in self.FIELDS:
                 setattr(self, f, 0.0 if "seconds" in f else 0)
+            # wire bytes placed per mesh-device index (the device-owned
+            # slice seam charges each device its own slice) — the
+            # multichip bench stamps the hot device's share to prove
+            # per-device feed bytes shrink ≈1/N with mesh width
+            self.wire_by_device: dict[int, int] = {}
 
     def add(self, **kw) -> None:
         with self._mu:
             for k, v in kw.items():
                 setattr(self, k, getattr(self, k) + v)
 
+    def add_device_bytes(self, per_dev) -> None:
+        with self._mu:
+            for d, b in enumerate(per_dev):
+                self.wire_by_device[d] = \
+                    self.wire_by_device.get(d, 0) + int(b)
+
     def snapshot(self) -> dict:
         with self._mu:
-            return {f: (round(getattr(self, f), 4)
-                        if "seconds" in f else int(getattr(self, f)))
-                    for f in self.FIELDS}
+            out = {f: (round(getattr(self, f), 4)
+                       if "seconds" in f else int(getattr(self, f)))
+                   for f in self.FIELDS}
+            n = max(self.wire_by_device, default=-1) + 1
+            out["wire_bytes_by_device"] = [
+                self.wire_by_device.get(d, 0) for d in range(n)]
+            return out
 
     def merge(self, other: "ScanPhaseStats") -> None:
         """Fold another accumulator in (a completed pipeline's local
@@ -103,7 +118,12 @@ class ScanPhaseStats:
         only builds whose feeds were actually used)."""
         with other._mu:
             vals = {f: getattr(other, f) for f in self.FIELDS}
+            per_dev_items = list(other.wire_by_device.items())
         self.add(**vals)
+        with self._mu:
+            for d, b in per_dev_items:
+                self.wire_by_device[d] = \
+                    self.wire_by_device.get(d, 0) + b
 
 
 def resolve_scan_mode(settings) -> str:
@@ -513,10 +533,19 @@ class _ScanPipeline:
 
     def _place(self, arr, category=None):
         """Accounted placement from the producer thread — the transfer
-        is in flight while the next column decodes."""
-        return self.acc.place_tracked(
-            self.mesh, arr, self.sharded,
-            self.category if category is None else category)
+        is in flight while the next column decodes.  Sharded buffers go
+        through the device-owned slice seam: each device's row slice
+        (built from only the shards it owns) dispatches as its own
+        transfer and charges its own per-device bytes."""
+        cat = self.category if category is None else category
+        if self.sharded:
+            slices = [arr[d] for d in range(arr.shape[0])]
+            out = self.acc.place_sharded_slices_tracked(
+                self.mesh, slices, cat)
+            if self.stats is not None:
+                self.stats.add_device_bytes([s.nbytes for s in slices])
+            return out
+        return self.acc.place_tracked(self.mesh, arr, False, cat)
 
     def _encode_and_place(self, ci: int, buf, nbuf):
         """Wire-encode (device mode) + place one column; returns the
@@ -762,4 +791,6 @@ class _ScanPipeline:
             self.stats_out.merge(self.stats)
         return FeedSpec(node=self.node, sharded=self.sharded,
                         arrays=arrays, nulls=nulls, valid=valid,
-                        capacity=self.cap)
+                        capacity=self.cap,
+                        dev_rows=(list(self.dev_rows) if self.sharded
+                                  else None))

@@ -360,14 +360,19 @@ class StreamBatcher:
         for d in range(n_dev):
             valid[d, :per_dev[d][1]] = True
         feed = FeedSpec(node=node, sharded=True, arrays=arrays,
-                        nulls=nulls, valid=valid, capacity=cap)
+                        nulls=nulls, valid=valid, capacity=cap,
+                        dev_rows=[per_dev[d][1] for d in range(n_dev)])
         # accounted placement (executor/hbm.py): a batch that does not
         # fit raises the classified DeviceMemoryExhausted through the
         # consumer queue, and its charge releases with the batch arrays
         acc = self.accountant
 
         def put(a):
-            return acc.place(self.mesh, a, True, "stream")
+            # device-owned slice seam: each device's batch rows (built
+            # from only its own shards' stripes) transfer independently
+            # and charge per device (executor/hbm.py)
+            return acc.place_sharded_slices(
+                self.mesh, [a[d] for d in range(self.n_dev)], "stream")
 
         t_put = time.perf_counter()
         feed.arrays = {c: put(a) for c, a in feed.arrays.items()}
@@ -642,6 +647,14 @@ def try_execute_streamed(executor, plan: QueryPlan, raw: bool,
     result.retries = retries_total
     result.device_rows_scanned = rows_scanned
     result.streamed_batches = n_consumed
+    from .runner import feed_device_rows
+
+    rows_in = feed_device_rows(
+        {k: v for k, v in feeds.items() if k != id(stream_node)}, n_dev)
+    totals = rows_in if rows_in is not None else [0] * n_dev
+    for d, r in enumerate(batcher._dev_rows):
+        totals[d] += int(r)
+    result.device_rows_in = totals
     if executor.counters is not None:
         from ..stats.counters import QUERIES_STREAMED
 

@@ -102,6 +102,47 @@ def plan_rebalance(catalog: Catalog, store: TableStore,
     return moves
 
 
+def rebalance_mesh(catalog: Catalog, store: TableStore, n_devices: int,
+                   threshold: float = 0.1, progress=None):
+    """Expand shard placements onto a grown mesh (1→N scale-out
+    without reloading): add catalog nodes until one exists per mesh
+    device, then spread shard placements over them with the ordinary
+    greedy rebalancer (citus_rebalance_mesh() UDF surface).
+
+    A data_dir created on a 1-device mesh holds every shard on one
+    node; reopened with n_devices=8 the node↔device map
+    (catalog.node_device_map) still folds everything onto device 0 —
+    feeds pad every device to the hot device's row count and 7 devices
+    chew zeros.  Growing the node set and moving placements (the
+    existing shard_transfer machinery — stripe files stay in place,
+    only the catalog flips) spreads the map, so the same data serves
+    from N devices with per-device feed bytes ≈ 1/N.
+
+    Returns (nodes_added, moves)."""
+    added = []
+    with catalog._lock:
+        existing = {n.name for n in catalog.nodes.values()}
+        i = 0
+        while len(catalog.active_nodes()) < max(1, n_devices):
+            name = f"device:{i}"
+            i += 1
+            if name in existing:
+                continue
+            added.append(catalog.add_node(name))
+    # grow-rebalance runs with improvement_threshold=0: that gate
+    # compares each move's gain against the peak's distance to the
+    # post-growth mean, and with N-1 freshly-empty nodes the FIRST move
+    # off the hot node can never clear 50% of that distance (1 group of
+    # K shrinks the peak by 1/K) — the steady-state damping rule would
+    # leave a grown mesh permanently unbalanced.  The imbalance
+    # `threshold` still applies, so an already-spread cluster moves
+    # nothing.
+    moves = rebalance_table_shards(catalog, store, threshold,
+                                   improvement_threshold=0.0,
+                                   progress=progress)
+    return added, moves
+
+
 def rebalance_table_shards(catalog: Catalog, store: TableStore,
                            threshold: float = 0.1,
                            improvement_threshold: float = 0.5,

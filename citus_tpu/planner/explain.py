@@ -48,6 +48,8 @@ EXPLAIN_TAGS: dict[str, str] = {
         "(executor/scanpipe.py; scan_pipeline=host|device)",
     "Streamed Execution": "scan ran via the batched stream pipeline",
     "Device Rows Scanned": "result-transfer volume in row slots",
+    "Mesh": "device count, per-device rows in/out, all_to_all bytes "
+            "for this statement",
     "Memory": "device-memory ledger + OOM degradation for this statement",
     "Resilience": "retry/failover totals for this statement",
     "Integrity": "stripes CRC-verified / read-repaired this statement",

@@ -215,13 +215,30 @@ class WindowNode(PlanNode):
 # planner context
 # --------------------------------------------------------------------------
 
-def table_placement(catalog: Catalog, table: str,
-                    n_devices: int) -> tuple[int, ...]:
+def table_placement(catalog: Catalog, table: str, n_devices: int,
+                    probe: bool = True) -> tuple[int, ...]:
     """shard index → device index map (the single source of the
-    node→device folding rule; feed placement and planners must agree)."""
-    return tuple(
-        (catalog.active_placement(s.shard_id).node_id - 1) % n_devices
-        for s in catalog.table_shards(table))
+    node→device rule; feed placement and planners must agree).
+
+    Routes through the catalog's explicit node↔device map
+    (catalog.node_device_map): active nodes ranked by node_id take
+    devices round-robin.  A placement on a node outside the map (a
+    suspect read failing over through a disabled node's replica) falls
+    back to the legacy node-id fold rather than erroring — the rows
+    still land on one deterministic device.
+
+    `probe=False` skips the catalog.placement_probe fault seam
+    (active_placement's estimation-caller contract): the WLM admission
+    byte estimator resolves placements per statement and must not
+    multiply — or consume — an armed probe fault meant for the
+    execution path."""
+    dmap = catalog.node_device_map(n_devices)
+    out = []
+    for s in catalog.table_shards(table):
+        node_id = catalog.active_placement(s.shard_id,
+                                           probe=probe).node_id
+        out.append(dmap.get(node_id, (node_id - 1) % n_devices))
+    return tuple(out)
 
 
 class StatsProvider:

@@ -48,7 +48,8 @@ _UDFS = ("create_distributed_table", "create_reference_table",
          "citus_stat_counters", "citus_stat_counters_reset",
          "citus_stat_statements", "citus_stat_statements_reset",
          "citus_stat_tenants", "citus_stat_activity", "citus_stat_wlm",
-         "citus_stat_serving", "citus_stat_memory",
+         "citus_stat_serving", "citus_stat_memory", "citus_stat_mesh",
+         "citus_rebalance_mesh",
          "get_rebalance_progress",
          "citus_split_shard_by_split_points", "isolate_tenant_to_node",
          "citus_cleanup_orphaned_resources",
@@ -125,6 +126,12 @@ class Session:
                     f"{SHARD_AXIS!r}, got {mesh.axis_names}")
             self.mesh = mesh
         else:
+            if n_devices is None:
+                # mesh_devices config var: the settings-level mesh
+                # width for sessions that pass no explicit n_devices
+                # (0 = every visible device, the historic default)
+                cfg = self.settings.get("mesh_devices")
+                n_devices = cfg or None
             self.mesh = make_mesh(n_devices)
         self.n_devices = len(self.mesh.devices.flatten())
         if not self.catalog.nodes:
@@ -1119,6 +1126,50 @@ class Session:
                 min(d["bytes_limit"] for d in dev) if dev else None)
             return ResultSet(list(cols),
                              {k: [v] for k, v in cols.items()}, 1)
+        elif e.name == "citus_stat_mesh":
+            # mesh snapshot: device count/platform, the catalog's
+            # node↔device map (the fact every shard feed routes
+            # through), cross-device shuffle volume and the measured
+            # per-device HBM ledger — the one-stop view of whether the
+            # cluster dimension is actually being used
+            import json as _json
+
+            import jax as _jax
+
+            from .stats import counters as sc
+
+            acc = self.executor.accountant
+            by_dev = acc.live_bytes_by_device()
+            dmap = self.catalog.node_device_map(self.n_devices)
+            csnap = self.stats.counters.snapshot()
+            cols = {
+                "devices": self.n_devices,
+                "platform": str(_jax.default_backend()),
+                "nodes": len(self.catalog.active_nodes()),
+                "node_device_map": _json.dumps(
+                    {str(k): v for k, v in sorted(dmap.items())}),
+                "shuffle_bytes_total": csnap.get(
+                    sc.SHUFFLE_BYTES_TOTAL, 0),
+                "live_bytes_by_device": _json.dumps(by_dev),
+                "live_bytes_hot_device": max(by_dev, default=0),
+            }
+            return ResultSet(list(cols),
+                             {k: [v] for k, v in cols.items()}, 1)
+        elif e.name == "citus_rebalance_mesh":
+            # grow the node set onto this session's mesh width and
+            # spread shard placements over the new nodes (1→N scale-out
+            # without reloading; operations/rebalancer.py)
+            from .operations.rebalancer import rebalance_mesh
+
+            added, moves = rebalance_mesh(
+                self.catalog, self.store, self.n_devices,
+                self.settings.get("rebalance_threshold"),
+                progress=self.stats.progress)
+            self._save_catalog()
+            return ResultSet(
+                ["nodes_added", "shards_moved"],
+                {"nodes_added": [len(added)],
+                 "shards_moved": [len(moves)]}, 1)
         elif e.name == "get_rebalance_progress":
             mons = self.stats.progress.all()
             return ResultSet(
@@ -1645,6 +1696,21 @@ class Session:
                     lines.append(
                         f"{explain_tag('Streamed Execution')}: "
                         f"{result.streamed_batches} batches")
+                # mesh trip: per-device rows in/out and the statement's
+                # static all_to_all volume (counter delta, the Chunks
+                # Skipped pattern) — whether the cluster dimension did
+                # real work is auditable from one EXPLAIN ANALYZE
+                d_shuf = self.stats.counters.snapshot().get(
+                    sc.SHUFFLE_BYTES_TOTAL, 0) - snap0.get(
+                    sc.SHUFFLE_BYTES_TOTAL, 0)
+                rows_in = result.device_rows_in
+                rows_out = result.device_rows
+                lines.append(
+                    f"{explain_tag('Mesh')}: devices={self.n_devices} "
+                    f"rows_in={rows_in if rows_in is not None else 'n/a'}"
+                    f" rows_out="
+                    f"{rows_out if rows_out is not None else 'n/a'} "
+                    f"all_to_all_bytes={d_shuf}")
                 # this statement's deltas (the Chunks Skipped pattern),
                 # plus session totals clearly labeled as such — a clean
                 # statement in a battle-scarred session must not read

@@ -44,6 +44,12 @@ DEVICE_DECODED_BYTES_TOTAL = "device_decoded_bytes_total"
 # statements whose plan executed the bucketed dense-grid group-by
 # (ops/groupby.py) instead of the sort path
 GROUPBY_BUCKETED_TOTAL = "groupby_bucketed_total"
+# static all_to_all shuffle buffer volume the executed plans moved over
+# the mesh (per-device capacity × devices² × row width, summed over the
+# plan's repartition stages and every stream batch) — the EXPLAIN
+# ANALYZE Mesh: line and bench_multichip.py read the per-statement
+# delta to show what cross-device scaling actually costs
+SHUFFLE_BYTES_TOTAL = "shuffle_bytes_total"
 # resilient statement execution (session retry loop / deadline seams)
 RETRIES_TOTAL = "retries_total"
 FAILOVERS_TOTAL = "failovers_total"
@@ -85,6 +91,7 @@ ALL_COUNTERS = [
     CAPACITY_RETRIES, DEVICE_ROWS_SCANNED,
     INSERT_SELECT_PUSHDOWN, INSERT_SELECT_REPARTITION, INSERT_SELECT_PULL,
     CHUNKS_SKIPPED, QUERIES_STREAMED, GROUPBY_BUCKETED_TOTAL,
+    SHUFFLE_BYTES_TOTAL,
     CHUNKS_PREFETCHED_TOTAL, PREFETCH_STALLS_TOTAL,
     DEVICE_DECODED_BYTES_TOTAL,
     RETRIES_TOTAL, FAILOVERS_TOTAL, TIMEOUTS_TOTAL, QUERIES_CANCELED,

@@ -129,9 +129,19 @@ def read_tables(stmt: ast.Statement) -> set[str]:
 
 def _base_table_bytes(stmt: ast.Statement, catalog: Catalog, store,
                       n_devices: int) -> tuple[dict[str, int], int]:
-    """Per-device feed bytes by table + total row count for the
+    """PER-DEVICE feed bytes by table + total row count for the
     statement's read tables (the raw material of both the base-feed
-    and the intermediate estimates)."""
+    and the intermediate estimates).
+
+    The per-device figure is the HOT device's: shard bytes fold onto
+    mesh devices through the catalog's node↔device map
+    (planner/plan.py table_placement) and the largest device-sum wins.
+    Dividing by n_devices assumed perfectly spread placements — a
+    skew-placed table (every shard on one node of a grown mesh, a
+    5-shard table on an 8-device mesh) under-estimated by up to N×,
+    and since the padded feed allocates the hot device's row count on
+    EVERY device, one hot device OOMs regardless of cluster-wide
+    headroom."""
     per_table: dict[str, int] = {}
     rows = 0
     for t in read_tables(stmt):
@@ -139,16 +149,26 @@ def _base_table_bytes(stmt: ast.Statement, catalog: Catalog, store,
             continue
         try:
             shards = catalog.table_shards(t)
-            tbytes = sum(store.shard_size_bytes(t, s.shard_id)
-                         for s in shards)
+            sizes = [store.shard_size_bytes(t, s.shard_id)
+                     for s in shards]
             meta = catalog.table(t)
             rows += store.table_row_count(t)
+            if meta.method == DistributionMethod.HASH and n_devices > 0:
+                from ..planner.plan import table_placement
+
+                # probe=False: estimation-only resolution must not
+                # consume an armed placement-probe fault meant for the
+                # execution path (active_placement's contract)
+                placement = table_placement(catalog, t, n_devices,
+                                            probe=False)
+                by_dev = [0] * n_devices
+                for dev, b in zip(placement, sizes):
+                    by_dev[dev] += b
+                per_table[t] = max(by_dev) if by_dev else 0
+            else:
+                per_table[t] = sum(sizes)  # reference/local: whole copy
         except (CatalogError, OSError, KeyError):
             continue  # table dropped/moved mid-estimate: skip its bytes
-        if meta.method == DistributionMethod.HASH and n_devices > 0:
-            per_table[t] = -(-tbytes // n_devices)
-        else:
-            per_table[t] = tbytes  # reference/local replicate whole
     return per_table, rows
 
 

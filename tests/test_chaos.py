@@ -127,16 +127,20 @@ def _run_soak_inner(tmp_path, n_ops: int, seed: int, fault_rate: float):
     rng = random.Random(seed)
     data_dir = str(tmp_path / "chaos")
     mk = lambda **kw: citus_tpu.connect(  # noqa: E731
-        data_dir=data_dir, n_devices=2, retry_backoff_base_ms=1,
+        data_dir=data_dir, retry_backoff_base_ms=1,
         retry_backoff_max_ms=5, max_statement_retries=2,
         shard_replication_factor=2, max_concurrent_statements=2,
-        **kw)
+        **{"n_devices": 2, **kw})
     # one session per scan_pipeline mode: the soak's mixed workload must
     # hold the oracle invariant on the eager path, the host pipeline AND
     # the on-device-decode pipeline concurrently (forced modes engage
-    # regardless of table size, so the new fault seams actually fire)
+    # regardless of table size, so the new fault seams actually fire).
+    # The device-decode session additionally runs the FULL 8-device mesh
+    # — repartition all_to_all / scan_prefetch / hbm_exhausted faults
+    # arm on the widest mesh path while the 2-device sessions prove
+    # parity across device counts on the same committed store
     sessions = [mk(scan_pipeline="off"), mk(scan_pipeline="host"),
-                mk(scan_pipeline="device")]
+                mk(scan_pipeline="device", n_devices=8)]
     s0 = sessions[0]
     s0.execute("CREATE TABLE kv (id INT, v INT)")
     s0.execute("SELECT create_distributed_table('kv', 'id', 4)")

@@ -1,0 +1,360 @@
+"""Device-resident sharded execution over a real mesh (PR 12).
+
+Covers the node↔device map (aliasing regressions), the device-owned
+slice placement seam, mesh growth (citus_rebalance_mesh), per-device
+budget enforcement (hot-device WLM estimate + a directed MemSim
+scenario where ONE device is over budget while the cluster-wide sum is
+under), the psum-directory aggregate pushdown, the Mesh observability
+surfaces, and a fuzzer-style parity slice pinning
+n_devices ∈ {1, 2, 8} row-identical under interleaved cross-session
+DML/COPY.
+"""
+
+import json
+import random
+
+import numpy as np
+import pytest
+
+import citus_tpu
+from citus_tpu.executor.hbm import accountant_for, oom_budget
+from citus_tpu.planner.plan import table_placement
+from citus_tpu.stats import counters as sc
+
+
+def _seed_kv(sess, n=4000, shard_count=8):
+    sess.execute("CREATE TABLE kv (id INT, v INT, grp INT)")
+    sess.execute(
+        f"SELECT create_distributed_table('kv', 'id', {shard_count})")
+    vals = ", ".join(f"({i}, {i * 3}, {i % 11})" for i in range(n))
+    sess.execute("INSERT INTO kv VALUES " + vals)
+    return n
+
+
+# ---------------------------------------------------------------------------
+# node↔device map
+
+
+class TestNodeDeviceMap:
+    def test_map_survives_node_churn_without_aliasing(self, tmp_path):
+        """The old (node_id - 1) % n_devices fold broke after a
+        remove+add cycle: the replacement node's id collided with a
+        live node's device while the removed node's device idled."""
+        sess = citus_tpu.connect(data_dir=str(tmp_path / "d"),
+                                 n_devices=4)
+        try:
+            cat = sess.catalog
+            cat.remove_node("device:2")
+            cat.add_node("late:node")  # node_id 5: old fold → device 0
+            dmap = cat.node_device_map(4)
+            assert len(dmap) == 4
+            # every device used exactly once — no fold, no idle device
+            assert sorted(dmap.values()) == [0, 1, 2, 3]
+        finally:
+            sess.close()
+
+    def test_five_shard_table_on_eight_device_mesh(self, tmp_path):
+        """Regression (plan.py:223): 5 shards must land on 5 DISTINCT
+        devices of an 8-device mesh, and results must be exact."""
+        sess = citus_tpu.connect(data_dir=str(tmp_path / "d"),
+                                 n_devices=8)
+        try:
+            n = _seed_kv(sess, n=1000, shard_count=5)
+            placement = table_placement(sess.catalog, "kv", 8)
+            assert len(placement) == 5
+            assert len(set(placement)) == 5, (
+                f"5 shards folded onto {len(set(placement))} devices: "
+                f"{placement}")
+            r = sess.execute("select count(*), sum(v) from kv")
+            assert r.rows()[0] == (n, sum(i * 3 for i in range(n)))
+        finally:
+            sess.close()
+
+
+# ---------------------------------------------------------------------------
+# device-owned slice placement
+
+
+def test_put_sharded_slices_matches_put_sharded():
+    import jax.numpy as jnp
+
+    from citus_tpu.distributed.mesh import (
+        make_mesh,
+        put_sharded,
+        put_sharded_slices,
+    )
+
+    mesh = make_mesh(4)
+    rng = np.random.default_rng(0)
+    arr = rng.integers(0, 1 << 40, size=(4, 256)).astype(np.int64)
+    whole = put_sharded(mesh, arr)
+    sliced = put_sharded_slices(mesh, [arr[d] for d in range(4)])
+    assert whole.shape == sliced.shape
+    assert whole.sharding == sliced.sharding
+    np.testing.assert_array_equal(np.asarray(whole), np.asarray(sliced))
+    assert bool(jnp.all(whole == sliced))
+
+
+def test_slice_placement_charges_per_device(tmp_path):
+    import gc
+
+    from citus_tpu.distributed.mesh import make_mesh
+
+    acc = accountant_for(str(tmp_path / "acc"))
+    mesh = make_mesh(4)
+    slices = [np.zeros(1024, np.int64) for _ in range(4)]
+    out, _handle = acc.place_sharded_slices_tracked(mesh, slices,
+                                                    "other")
+    by_dev = acc.live_bytes_by_device()
+    assert by_dev[:4] == [8192, 8192, 8192, 8192]
+    assert acc.live_bytes("other") == 8192  # per-device figure
+    del out
+    gc.collect()
+    assert acc.live_bytes("other") == 0
+    assert all(b == 0 for b in acc.live_bytes_by_device())
+
+
+# ---------------------------------------------------------------------------
+# mesh growth + per-device budgets
+
+
+class TestMeshGrowth:
+    def test_rebalance_mesh_grows_and_spreads(self, tmp_path):
+        data_dir = str(tmp_path / "d")
+        s1 = citus_tpu.connect(data_dir=data_dir, n_devices=1)
+        n = _seed_kv(s1, n=2000, shard_count=8)
+        want = s1.execute("select count(*), sum(v) from kv").rows()[0]
+        s1.close()
+
+        s8 = citus_tpu.connect(data_dir=data_dir, n_devices=8)
+        try:
+            # pre-rebalance: the 1-node catalog folds everything onto
+            # device 0 of the grown mesh
+            assert set(table_placement(s8.catalog, "kv", 8)) == {0}
+            r = s8.execute("select citus_rebalance_mesh()")
+            row = dict(zip(r.column_names, r.rows()[0]))
+            assert row["nodes_added"] == 7
+            assert row["shards_moved"] > 0
+            placement = table_placement(s8.catalog, "kv", 8)
+            assert len(set(placement)) == 8, placement
+            assert s8.execute(
+                "select count(*), sum(v) from kv").rows()[0] == want
+            # idempotent: a second call adds nothing
+            r2 = s8.execute("select citus_rebalance_mesh()")
+            assert dict(zip(r2.column_names,
+                            r2.rows()[0]))["nodes_added"] == 0
+        finally:
+            s8.close()
+
+    def test_per_device_budget_skew_degrades_then_rebalance_fits(
+            self, tmp_path):
+        """Directed per-device OOM enforcement: with every shard on one
+        node of an 8-device mesh, the hot device drives the padded feed
+        capacity for EVERY device, so the per-device need is ~8× the
+        balanced case while the cluster-wide data volume (sum/8) fits
+        the budget comfortably.  The armed MemSim budget must fail that
+        hot allocation and the ladder must degrade to a clean, correct
+        answer — then citus_rebalance_mesh() spreads the placement and
+        the SAME budget executes without a single new OOM."""
+        data_dir = str(tmp_path / "d")
+        s1 = citus_tpu.connect(data_dir=data_dir, n_devices=1)
+        n = _seed_kv(s1, n=20000, shard_count=8)
+        s1.close()
+
+        sql = "select count(*), sum(v) from kv"
+        want = (n, sum(i * 3 for i in range(n)))
+        s8 = citus_tpu.connect(data_dir=data_dir, n_devices=8,
+                               retry_backoff_base_ms=1,
+                               retry_backoff_max_ms=5,
+                               serving_result_cache_bytes=0)
+        try:
+            acc = accountant_for(data_dir)
+            # rehearse the skew-placed execution to size the budget
+            with oom_budget(acc):
+                s8.execute(sql)
+            skew_peak = acc.peak_bytes
+            budget = max(1, skew_peak // 2)
+            s8.executor.feed_cache.clear()
+            snap0 = s8.stats.counters.snapshot()
+            with oom_budget(acc, budget=budget):
+                r = s8.execute(sql)
+            assert r.rows()[0] == want
+            snap = s8.stats.counters.snapshot()
+            assert snap[sc.OOM_EVENTS_TOTAL] > snap0[sc.OOM_EVENTS_TOTAL], \
+                "budget below the skewed hot-device peak must OOM"
+
+            # grow the mesh: per-device need drops ~8×, same budget fits
+            s8.execute("select citus_rebalance_mesh()")
+            s8.executor.feed_cache.clear()
+            snap1 = s8.stats.counters.snapshot()
+            with oom_budget(acc, budget=budget):
+                r = s8.execute(sql)
+            assert r.rows()[0] == want
+            snap2 = s8.stats.counters.snapshot()
+            assert snap2[sc.OOM_EVENTS_TOTAL] == snap1[sc.OOM_EVENTS_TOTAL], \
+                "spread placement must fit the same per-device budget"
+        finally:
+            s8.close()
+
+    def test_wlm_estimate_uses_hot_device(self, tmp_path):
+        """planned_feed_bytes must size by the hottest device's shard
+        bytes, not total/n_devices — a skew-placed table under-gated
+        by up to N×."""
+        from citus_tpu.sql import parse
+        from citus_tpu.wlm import planned_feed_bytes
+
+        data_dir = str(tmp_path / "d")
+        s1 = citus_tpu.connect(data_dir=data_dir, n_devices=1)
+        _seed_kv(s1, n=5000, shard_count=8)
+        s1.close()
+        s8 = citus_tpu.connect(data_dir=data_dir, n_devices=8)
+        try:
+            stmt = parse("select count(*) from kv")[0]
+            skewed = planned_feed_bytes(stmt, s8.catalog, s8.store, 8,
+                                        s8.settings)
+            total = sum(s8.store.shard_size_bytes("kv", s.shard_id)
+                        for s in s8.catalog.table_shards("kv"))
+            # every shard on one device: the hot-device estimate is the
+            # WHOLE table, not total/8
+            assert skewed >= total
+            s8.execute("select citus_rebalance_mesh()")
+            spread = planned_feed_bytes(stmt, s8.catalog, s8.store, 8,
+                                        s8.settings)
+            assert spread < skewed / 4
+        finally:
+            s8.close()
+
+
+# ---------------------------------------------------------------------------
+# psum-directory pushdown + Mesh observability
+
+
+class TestMeshObservability:
+    def test_psum_directory_pushdown_exact_and_shuffle_free(
+            self, tmp_path):
+        sess = citus_tpu.connect(data_dir=str(tmp_path / "d"),
+                                 n_devices=4)
+        try:
+            sess.execute("CREATE TABLE a (k INT, x INT)")
+            sess.execute("SELECT create_distributed_table('a', 'k', 4)")
+            sess.execute("CREATE TABLE b (k INT, y INT)")
+            sess.execute("SELECT create_distributed_table('b', 'k', 4)")
+            rng = random.Random(3)
+            av = [(i, rng.randrange(100)) for i in range(2000)]
+            bv = [(rng.randrange(150), i) for i in range(1500)]
+            sess.execute("INSERT INTO a VALUES " +
+                         ", ".join(f"({k}, {x})" for k, x in av))
+            sess.execute("INSERT INTO b VALUES " +
+                         ", ".join(f"({k}, {y})" for k, y in bv))
+            # join on two NON-distribution columns → repart_both shape;
+            # the global count(*) pushdown takes the psum directory
+            snap0 = sess.stats.counters.snapshot()
+            r = sess.execute("select count(*) from a, b "
+                             "where a.x = b.k")
+            from collections import Counter
+
+            bc = Counter(k for k, _ in bv)
+            want = sum(bc.get(x, 0) for _, x in av)
+            assert int(r.rows()[0][0]) == want
+            snap = sess.stats.counters.snapshot()
+            assert snap[sc.SHUFFLE_BYTES_TOTAL] == \
+                snap0[sc.SHUFFLE_BYTES_TOTAL], \
+                "psum-directory pushdown must not pay an all_to_all"
+            # the GROUPED aggregate over the same join is pushdown-
+            # ineligible: it must pay the real repartition all_to_all
+            sess.execute("select a.x, count(*) from a, b "
+                         "where a.x = b.k group by a.x")
+            assert sess.stats.counters.snapshot()[
+                sc.SHUFFLE_BYTES_TOTAL] > snap[sc.SHUFFLE_BYTES_TOTAL]
+        finally:
+            sess.close()
+
+    def test_mesh_explain_line_and_stat_udf(self, tmp_path):
+        sess = citus_tpu.connect(data_dir=str(tmp_path / "d"),
+                                 n_devices=2)
+        try:
+            _seed_kv(sess, n=3000, shard_count=4)
+            plan = sess.execute(
+                "explain analyze select grp, count(*) from kv "
+                "group by grp")
+            text = "\n".join(plan.columns["QUERY PLAN"])
+            assert "Mesh: devices=2" in text
+            assert "rows_in=" in text and "all_to_all_bytes=" in text
+            r = sess.execute("select citus_stat_mesh()")
+            row = dict(zip(r.column_names, r.rows()[0]))
+            assert row["devices"] == 2
+            dmap = json.loads(row["node_device_map"])
+            assert sorted(dmap.values()) == [0, 1]
+            by_dev = json.loads(row["live_bytes_by_device"])
+            assert len(by_dev) >= 2
+        finally:
+            sess.close()
+
+    def test_mesh_rows_in_per_device(self, tmp_path):
+        sess = citus_tpu.connect(data_dir=str(tmp_path / "d"),
+                                 n_devices=2)
+        try:
+            n = _seed_kv(sess, n=2000, shard_count=4)
+            r = sess.execute("select id, v from kv")
+            assert r.device_rows_in is not None
+            assert sum(r.device_rows_in) == n
+            assert all(rows > 0 for rows in r.device_rows_in)
+        finally:
+            sess.close()
+
+
+# ---------------------------------------------------------------------------
+# parity slice: n_devices ∈ {1, 2, 8} row-identical under DML
+
+
+def _rows_sorted(res):
+    return sorted(tuple(r) for r in res.rows())
+
+
+@pytest.mark.parametrize("seed", [11])
+def test_parity_across_device_counts(tmp_path, seed):
+    """The fuzzer parity slice (acceptance): the SAME data_dir read
+    through 1-, 2- and 8-device sessions returns row-identical results
+    while a writer session interleaves DML + COPY between reads."""
+    data_dir = str(tmp_path / "d")
+    writer = citus_tpu.connect(data_dir=data_dir, n_devices=8,
+                               serving_result_cache_bytes=0)
+    n = _seed_kv(writer, n=3000, shard_count=8)
+    readers = [citus_tpu.connect(data_dir=data_dir, n_devices=d,
+                                 serving_result_cache_bytes=0)
+               for d in (1, 2, 8)]
+    rng = random.Random(seed)
+    queries = [
+        "select count(*), sum(v) from kv",
+        "select grp, count(*), sum(v) from kv group by grp",
+        "select id, v from kv where v % 7 = 0",
+        "select a.grp, count(*) from kv a, kv b "
+        "where a.v = b.id group by a.grp",
+    ]
+    try:
+        for step in range(6):
+            # interleaved cross-session DML/COPY
+            kind = step % 3
+            if kind == 0:
+                base = n + step * 100
+                writer.execute("INSERT INTO kv VALUES " + ", ".join(
+                    f"({base + i}, {rng.randrange(9000)}, {i % 11})"
+                    for i in range(50)))
+            elif kind == 1:
+                writer.execute(
+                    f"DELETE FROM kv WHERE id % 13 = {step % 13}")
+            else:
+                csv = tmp_path / f"copy_{step}.csv"
+                csv.write_text("\n".join(
+                    f"{n + 10_000 + step * 100 + i},{rng.randrange(9000)},"
+                    f"{i % 11}" for i in range(40)) + "\n")
+                writer.execute(
+                    f"COPY kv FROM '{csv}' WITH (FORMAT csv)")
+            q = queries[step % len(queries)]
+            got = [_rows_sorted(rd.execute(q)) for rd in readers]
+            assert got[0] == got[1] == got[2], (
+                f"step {step}: device counts disagree on {q!r}")
+    finally:
+        writer.close()
+        for rd in readers:
+            rd.close()
