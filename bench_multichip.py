@@ -151,8 +151,68 @@ def _child(n_devices: int) -> None:
                 "platform": platform,
                 "host_fake_devices": platform == "cpu",
             }), flush=True)
+        if n_devices >= 2:
+            # LAST (the failover shrinks this session's mesh): measured
+            # kill-to-first-answer recovery under a mid-query device
+            # loss — the number a preemption-tolerant stack lives by
+            _device_loss_scenario(sess, n_devices, platform)
     finally:
         sess.close()
+
+
+def _device_loss_scenario(sess, n_devices: int, platform: str) -> None:
+    """Kill one fake device mid-query (MeshSim) and measure the wall
+    clock from the kill to the first correct answer through the
+    shrink-and-failover path.  Runs on its own replication-2 table
+    (the TPC-H bench tables are replication 1 by design); the table is
+    dropped afterward so the cached dataset dir stays canonical."""
+    from citus_tpu.stats import counters as sc
+    from citus_tpu.utils import faultinjection as fi
+
+    sess.execute("DROP TABLE IF EXISTS dl_kv")
+    sess.execute("SET shard_replication_factor = 2")
+    sess.execute("CREATE TABLE dl_kv (id INT, v INT, grp INT)")
+    sess.execute(
+        f"SELECT create_distributed_table('dl_kv', 'id', {n_devices})")
+    n = 60_000
+    for base in range(0, n, 10_000):
+        sess.execute("INSERT INTO dl_kv VALUES " + ", ".join(
+            f"({base + i}, {(base + i) * 3}, {(base + i) % 13})"
+            for i in range(10_000)))
+    q = "select grp, count(*), sum(v) from dl_kv group by grp"
+    warm = sorted(map(tuple, sess.execute(q).rows()))
+    t_warm0 = time.perf_counter()
+    sess.execute(q)
+    warm_s = time.perf_counter() - t_warm0
+    victim = sess.mesh.devices.flat[n_devices - 1].id
+    snap0 = sess.stats.counters.snapshot()
+    # after=1: feeds are warm, so the kill lands at the result fetch —
+    # the program RAN and its answer died on the wire (mid-query)
+    with fi.simulate_mesh(kill={victim}, after=1):
+        t0 = time.perf_counter()
+        r = sess.execute(q)
+        recovery_s = time.perf_counter() - t0
+    ok = sorted(map(tuple, r.rows())) == warm
+    snap = sess.stats.counters.snapshot()
+    rescued = (snap.get(sc.QUERIES_RESCUED_TOTAL, 0)
+               - snap0.get(sc.QUERIES_RESCUED_TOTAL, 0))
+    sess.execute("DROP TABLE dl_kv")
+    print(json.dumps({
+        "metric": "multichip_device_loss_recovery_seconds",
+        "n_devices": n_devices,
+        "value": round(recovery_s, 4),
+        "unit": "s",
+        "sf": _sf(),
+        "rows_processed": n,
+        "warm_seconds": round(warm_s, 4),
+        "recovery_over_warm": (round(recovery_s / warm_s, 2)
+                               if warm_s > 0 else None),
+        "devices_after_failover": sess.n_devices,
+        "queries_rescued_total": int(rescued),
+        "oracle_identical": bool(ok),
+        "platform": platform,
+        "host_fake_devices": platform == "cpu",
+    }), flush=True)
 
 
 # ---------------------------------------------------------------------------

@@ -141,7 +141,7 @@ FAMILY_RULES = {
     "discipline": frozenset({"bare-except", "swallowed-base-exception",
                              "swallowed-fault-seam", "silent-exception",
                              "unowned-thread", "raw-durable-write",
-                             "raw-device-placement"}),
+                             "raw-device-placement", "mesh-seam"}),
 }
 
 

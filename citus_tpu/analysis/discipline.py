@@ -37,6 +37,19 @@
   raw-durable-write pattern applied to device memory.  Genuinely
   unaccounted placements (single-scalar health probes) justify
   themselves inline.
+* ``mesh-seam`` — ``jax.device_put(x, <specific device>)`` (a second
+  positional argument or a ``device=`` keyword) anywhere in
+  ``citus_tpu/`` outside ``distributed/mesh.py``: a transfer aimed at
+  ONE device is exactly where a dying device refuses its slice, so it
+  must go through the mesh seams (``put_sharded_slices`` et al.) where
+  the ``mesh.device_put`` fault point, the MeshSim device checks and
+  the ``DeviceLostError`` classification all live — the HBM-seam
+  pattern applied to the device-loss dimension.  Sharding-targeted
+  ``device_put`` (a NamedSharding second argument) is the
+  raw-device-placement rule's business, but statically the two are
+  indistinguishable, so any targeted put outside the seam flags here
+  and genuinely exempt sites (single-device health probes) justify
+  themselves inline.
 """
 
 from __future__ import annotations
@@ -55,6 +68,11 @@ _IO_SEAM = ("citus_tpu/utils/io.py", "citus_tpu/utils/crashsim.py")
 # accounted seam itself, plus the mesh helpers it drives
 _PLACEMENT_SEAM = ("citus_tpu/executor/hbm.py",
                    "citus_tpu/distributed/mesh.py")
+
+# the sanctioned home of device-TARGETED transfers (the device-loss
+# fault surface): only the mesh module may aim a device_put at one
+# specific device
+_MESH_SEAM = ("citus_tpu/distributed/mesh.py",)
 
 
 def _is_write_mode(node: ast.Call) -> bool:
@@ -154,6 +172,7 @@ class _Visitor(ast.NodeVisitor):
         fn = node.func
         self._check_raw_durable_write(node, fn)
         self._check_raw_device_placement(node, fn)
+        self._check_mesh_seam(node, fn)
         is_thread_ctor = (
             isinstance(fn, ast.Attribute) and fn.attr == "Thread"
             and isinstance(fn.value, ast.Name)
@@ -215,6 +234,30 @@ class _Visitor(ast.NodeVisitor):
                        "device placement must flow through the "
                        "accounted seam; justify genuinely unaccounted "
                        "placements inline")
+
+    def _check_mesh_seam(self, node: ast.Call, fn) -> None:
+        """`jax.device_put(x, target)` — a transfer aimed at a specific
+        device/sharding — outside distributed/mesh.py bypasses the
+        mesh.device_put fault point, the MeshSim device checks and the
+        DeviceLostError classification."""
+        if not self.mod.relpath.startswith("citus_tpu/") or \
+                self.mod.relpath in _MESH_SEAM:
+            return
+        if not (isinstance(fn, ast.Attribute) and fn.attr == "device_put"
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id == "jax"):
+            return
+        targeted = len(node.args) >= 2 or any(
+            kw.arg in ("device", "dst") for kw in node.keywords)
+        if targeted:
+            self._flag("mesh-seam", node,
+                       "device-targeted jax.device_put() outside "
+                       "distributed/mesh.py — per-device transfers "
+                       "must go through the mesh seams "
+                       "(put_sharded_slices / put_sharded / "
+                       "put_replicated) so the mesh.device_put fault "
+                       "point, MeshSim device-loss checks and "
+                       "DeviceLostError classification all apply")
 
     def _joined_nearby(self) -> bool:
         """The enclosing function (or class, for threads stored on self

@@ -173,6 +173,22 @@ class Catalog:
         # retry lands elsewhere (in-memory, this process only — the
         # adaptive-executor transient-failure mark, not a catalog fact)
         self._suspect_placements: set[int] = set()
+        # mesh health ledger (in-memory, this process — the suspect-
+        # placement pattern applied to the device dimension): nodes the
+        # mesh-degrade path declared dead drop out of active_nodes()
+        # and placement routing WITHOUT flipping the persisted
+        # is_active flag (a lost device is this session's observation,
+        # not an operator's catalog fact); _device_states tracks each
+        # jax device id through active → suspect → draining → dead for
+        # citus_stat_mesh()
+        self._dead_nodes: set[int] = set()
+        self._device_states: dict[int, str] = {}
+        # mesh positions drained by citus_drain_device(): the
+        # node↔device map stops assigning nodes there, so the device
+        # keeps its mesh slot but feeds zero rows (without parking,
+        # the round-robin fold would simply repack the surviving nodes
+        # onto the drained position)
+        self._parked_devices: set[int] = set()
         self._next_shard_id = 102008   # reference shard ids start ~102008
         self._next_placement_id = 1
         self._next_node_id = 1
@@ -322,8 +338,18 @@ class Catalog:
         with self._lock:
             node = self.node_by_name(name)
             for p in self.placements.values():
-                if p.node_id != node.node_id or p.shard_state != "active" \
-                        or self.shards[p.shard_id].min_value is None:
+                if p.node_id != node.node_id or p.shard_state != "active":
+                    continue
+                meta = self.tables.get(
+                    self.shards[p.shard_id].table_name)
+                if meta is not None and \
+                        meta.method == DistributionMethod.REFERENCE:
+                    # reference replicas exist on every other node.
+                    # LOCAL tables share the single-shard shape but
+                    # hold their ONLY placement — the survivor check
+                    # below must protect them too (the old min_value
+                    # exemption silently deleted a local table's data
+                    # on node removal)
                     continue
                 # removable only if every hosted shard keeps at least one
                 # replica on another live node (reference semantics: a
@@ -363,6 +389,11 @@ class Catalog:
         with self._lock:
             node = self.node_by_name(name)
             node.is_active = True
+            self._dead_nodes.discard(node.node_id)
+            # re-activating a node un-parks drained positions too: the
+            # operator is declaring the mesh healthy, and a stale park
+            # would strand the node's placements off the fold
+            self._parked_devices.clear()
             self._bump()
         self.clear_placement_suspects(node.node_id)
 
@@ -373,8 +404,57 @@ class Catalog:
         raise CatalogError(f"node {name!r} does not exist")
 
     def active_nodes(self) -> list[NodeMetadata]:
-        return sorted((n for n in self.nodes.values() if n.is_active),
+        return sorted((n for n in self.nodes.values()
+                       if n.is_active
+                       and n.node_id not in self._dead_nodes),
                       key=lambda n: n.node_id)
+
+    # -- mesh health ledger -------------------------------------------------
+    def mark_node_dead(self, node_id: int) -> None:
+        """Device-loss observation: the node's device stopped
+        answering, so the node drops out of active_nodes(), the
+        node↔device map and placement routing — replicated shards fail
+        over to their surviving placements exactly as if the node were
+        disabled, but nothing is persisted (a reopened process probes a
+        healthy mesh again)."""
+        with self._lock:
+            self._dead_nodes.add(node_id)
+            self._bump()
+
+    def dead_nodes(self) -> set[int]:
+        with self._lock:
+            return set(self._dead_nodes)
+
+    def revive_nodes(self) -> None:
+        """Forget every device-loss observation (operator recovery
+        declaration; citus_activate_node clears per-node)."""
+        with self._lock:
+            self._dead_nodes.clear()
+            self._device_states.clear()
+            self._parked_devices.clear()
+            self._bump()
+
+    def set_device_state(self, device_id: int, state: str) -> None:
+        """Track a jax device through the health states
+        active | suspect | draining | dead (citus_stat_mesh surface;
+        'active' clears the entry)."""
+        if state not in ("active", "suspect", "draining", "dead"):
+            raise CatalogError(f"unknown device state {state!r}")
+        with self._lock:
+            if state == "active":
+                self._device_states.pop(device_id, None)
+            else:
+                self._device_states[device_id] = state
+
+    def device_states(self) -> dict[int, str]:
+        """Non-active device health entries (jax device id → state)."""
+        with self._lock:
+            return dict(self._device_states)
+
+    def _node_live(self, node_id: int) -> bool:
+        n = self.nodes.get(node_id)
+        return (n is not None and n.is_active
+                and node_id not in self._dead_nodes)
 
     def node_device_map(self, n_devices: int) -> dict[int, int]:
         """Explicit node_id → mesh-device-index map — THE catalog fact
@@ -389,10 +469,27 @@ class Catalog:
         than devices still folds (a mesh slot hosts several logical
         nodes — the 1-device test mesh runs every node); fewer leaves
         trailing devices empty until citus_rebalance_mesh() grows the
-        node set (operations/rebalancer.py)."""
+        node set (operations/rebalancer.py).  Positions parked by
+        citus_drain_device() are skipped, so a drained device really
+        idles instead of being re-occupied by the fold."""
         with self._lock:
-            return {n.node_id: i % max(1, n_devices)
+            slots = [i for i in range(max(1, n_devices))
+                     if i not in self._parked_devices]
+            if not slots:  # every slot parked: parking is advisory
+                slots = list(range(max(1, n_devices)))
+            return {n.node_id: slots[i % len(slots)]
                     for i, n in enumerate(self.active_nodes())}
+
+    def park_device(self, position: int) -> None:
+        """Take one mesh position out of the node↔device fold
+        (citus_drain_device — the device slot idles until revived)."""
+        with self._lock:
+            self._parked_devices.add(position)
+            self._bump()
+
+    def parked_devices(self) -> set[int]:
+        with self._lock:
+            return set(self._parked_devices)
 
     # -- colocation --------------------------------------------------------
     def get_or_create_colocation_group(
@@ -514,11 +611,11 @@ class Catalog:
 
             fault_point("catalog.placement_probe")
         ps = self.shard_placements(shard_id)
-        live = [p for p in ps
-                if (n := self.nodes.get(p.node_id)) is not None
-                and n.is_active]
+        live = [p for p in ps if self._node_live(p.node_id)]
         if not live:
-            raise CatalogError(
+            from ..errors import PlacementLostError
+
+            raise PlacementLostError(
                 f"shard {shard_id} has no active placement on a live node")
         if self._suspect_placements:
             trusted = [p for p in live
@@ -542,8 +639,7 @@ class Catalog:
         others = [q for q in self.shard_placements(p.shard_id)
                   if q.placement_id != placement_id
                   and q.placement_id not in self._suspect_placements
-                  and (n := self.nodes.get(q.node_id)) is not None
-                  and n.is_active]
+                  and self._node_live(q.node_id)]
         return bool(others)
 
     def clear_placement_suspect(self, placement_id: int) -> None:

@@ -59,6 +59,19 @@ _register(ConfigVar(
     int, min_value=1, max_value=64))
 
 _register(ConfigVar(
+    "mesh_failover", True,
+    "Query-level failover on device loss: when a mesh device dies, "
+    "hangs or errors mid-statement (DeviceLostError), rebuild a "
+    "shrunken mesh from the survivors, mark the dead device's nodes in "
+    "the catalog health ledger, re-route shard reads onto surviving "
+    "replica placements (shard_replication_factor >= 2) and re-execute "
+    "the statement.  Off = a DeviceLostError surfaces immediately "
+    "(legacy fail-fast semantics).  No direct reference equivalent — "
+    "closest is the adaptive executor's task failover on connection "
+    "loss (adaptive_executor.c:95-116).",
+    bool))
+
+_register(ConfigVar(
     "mesh_devices", 0,
     "Mesh width for new sessions that pass no explicit n_devices: use "
     "this many devices of the backend (0 = every visible device).  The "

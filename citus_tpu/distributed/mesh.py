@@ -4,6 +4,16 @@ The reference's "cluster" is worker nodes wired by libpq
 (connection/connection_management.c); here it is a jax.sharding.Mesh with a
 single 'shards' axis.  Multi-host TPU pods extend the same mesh over
 ICI/DCN transparently (jax.distributed) — the executor code is identical.
+
+Fault surface: a real TPU loses devices at three seams — the per-device
+host→HBM transfer, the collective dispatch, and the result fetch.  Those
+are named fault points here (``mesh.device_put``; the runner owns
+``mesh.collective`` / ``mesh.fetch``) and the armed MeshSim
+(utils/faultinjection.py) kills/hangs/errors chosen fake devices at
+them, so the whole failover path is drivable on a CPU test mesh.  Real
+backend errors that match the device-loss signature are classified via
+:func:`is_device_loss` and wrapped into ``DeviceLostError`` at the
+accounted placement seam (executor/hbm.py) and the runner.
 """
 
 from __future__ import annotations
@@ -12,15 +22,84 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..errors import DeviceLostError, ExecutionError
+from ..utils.faultinjection import fault_point, mesh_device_check
+
 SHARD_AXIS = "shards"
 
+# substrings the XLA runtime puts in errors that mean "a device (or its
+# link) is gone", as opposed to a compile bug or an allocator OOM — the
+# DeviceLostError classification key (the analogue of the reference
+# treating a libpq connection error as a worker failure)
+_DEVICE_LOSS_TOKENS = (
+    "DATA_LOSS",
+    "device is in an error state",
+    "Device or resource busy",
+    "device failed",
+    "halted execution",
+    "device unavailable",
+)
 
-def make_mesh(n_devices: int | None = None) -> Mesh:
+
+def is_device_loss(exc: BaseException) -> bool:
+    """Does this backend exception report a lost/failed device (rather
+    than a semantic error or an allocator OOM)?"""
+    msg = str(exc)
+    return any(tok in msg for tok in _DEVICE_LOSS_TOKENS)
+
+
+def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
+    """Build the single-axis mesh.  ``devices`` takes an explicit
+    device list — the mesh-degrade path rebuilds a shrunken mesh from
+    the SURVIVORS of a device loss, which are not a prefix of
+    jax.devices()."""
+    if devices is not None:
+        devs = list(devices)
+        if not devs:
+            raise ValueError("cannot build a mesh over zero devices")
+        return jax.make_mesh((len(devs),), (SHARD_AXIS,),
+                             devices=np.array(devs))
     devs = jax.devices()
     n = n_devices or len(devs)
     if n > len(devs):
         raise ValueError(f"requested {n} devices, only {len(devs)} available")
     return jax.make_mesh((n,), (SHARD_AXIS,), devices=np.array(devs[:n]))
+
+
+def mesh_device_ids(mesh: Mesh) -> list[int]:
+    """The jax device ids a mesh spans, in mesh-position order — the
+    identity the MeshSim kill set and the catalog's device health
+    ledger are keyed on (positions renumber when the mesh shrinks;
+    device ids never do)."""
+    return [d.id for d in mesh.devices.flat]
+
+
+def mesh_without(mesh: Mesh, dead_ids) -> Mesh | None:
+    """The survivors' mesh after losing `dead_ids`, or None when no
+    device survives (total mesh loss — nothing to fail over to)."""
+    dead = set(dead_ids)
+    survivors = [d for d in mesh.devices.flat if d.id not in dead]
+    if not survivors:
+        return None
+    return make_mesh(devices=survivors)
+
+
+def probe_mesh_devices(mesh: Mesh) -> list[int]:
+    """Health-probe every device of the mesh with a one-scalar transfer
+    and return the ids that failed — the detection pass for an opaque
+    collective failure (DeviceLostError with device_id=None): a dead
+    collective names no corpse, so the session asks each device
+    directly (the reference's connection-level health probe,
+    health_check.c, applied to mesh slots)."""
+    dead: list[int] = []
+    one = np.zeros(1, dtype=np.int32)
+    for d in mesh.devices.flat:
+        try:
+            mesh_device_check("mesh.device_put", (d.id,))
+            jax.device_put(one, d)  # graftlint: ignore[mesh-seam, raw-device-placement] — the health probe IS the seam's detection pass; single-scalar, deliberately unaccounted
+        except Exception:
+            dead.append(d.id)
+    return dead
 
 
 def sharded_spec() -> P:
@@ -33,7 +112,13 @@ def replicated_spec() -> P:
 
 def put_sharded(mesh: Mesh, arr: np.ndarray) -> jax.Array:
     """[n_dev, ...] host array → device array split on axis 0."""
-    return jax.device_put(arr, NamedSharding(mesh, P(SHARD_AXIS)))
+    fault_point("mesh.device_put")
+    mesh_device_check("mesh.device_put", mesh_device_ids(mesh))
+    try:
+        return jax.device_put(arr, NamedSharding(mesh, P(SHARD_AXIS)))
+    except Exception as e:
+        _reraise_if_device_loss(e, "mesh.device_put")
+        raise
 
 
 def put_sharded_slices(mesh: Mesh, slices) -> jax.Array:
@@ -45,18 +130,56 @@ def put_sharded_slices(mesh: Mesh, slices) -> jax.Array:
     host-side [n_dev, ...] concat pushed through a single device_put.
     The assembled global array carries NamedSharding(P(SHARD_AXIS)),
     indistinguishable to the compiled program from a put_sharded feed.
+
+    Every slice must share slices[0]'s shape: the global array is
+    assembled from the per-device buffers by shape arithmetic, and a
+    mismatched slice used to surface as a corrupt global array or an
+    opaque XLA shape error long after this call.
     """
     devs = list(mesh.devices.flat)
     if len(slices) != len(devs):
         raise ValueError(
             f"need one slice per device: {len(slices)} != {len(devs)}")
+    want = tuple(slices[0].shape)
+    for i, s in enumerate(slices):
+        if tuple(s.shape) != want:
+            raise ExecutionError(
+                f"put_sharded_slices: slice {i} has shape "
+                f"{tuple(s.shape)}, expected {want} (all per-device "
+                "slices must be padded to one capacity)")
+    fault_point("mesh.device_put")
     sharding = NamedSharding(mesh, P(SHARD_AXIS))
-    bufs = [jax.device_put(s[None, ...], d)
-            for s, d in zip(slices, devs)]
-    global_shape = (len(devs),) + tuple(slices[0].shape)
+    bufs = []
+    for s, d in zip(slices, devs):
+        # per-device seam: THE moment a dying device refuses its slice
+        mesh_device_check("mesh.device_put", (d.id,))
+        try:
+            bufs.append(jax.device_put(s[None, ...], d))
+        except Exception as e:
+            _reraise_if_device_loss(e, "mesh.device_put", d.id)
+            raise
+    global_shape = (len(devs),) + want
     return jax.make_array_from_single_device_arrays(
         global_shape, sharding, bufs)
 
 
 def put_replicated(mesh: Mesh, arr: np.ndarray) -> jax.Array:
-    return jax.device_put(arr, NamedSharding(mesh, P()))
+    fault_point("mesh.device_put")
+    mesh_device_check("mesh.device_put", mesh_device_ids(mesh))
+    try:
+        return jax.device_put(arr, NamedSharding(mesh, P()))
+    except Exception as e:
+        _reraise_if_device_loss(e, "mesh.device_put")
+        raise
+
+
+def _reraise_if_device_loss(e: BaseException, seam: str,
+                            device_id: int | None = None) -> None:
+    """Wrap a backend error matching the device-loss signature into the
+    classified DeviceLostError (no-op otherwise — caller re-raises)."""
+    if isinstance(e, DeviceLostError):
+        raise e
+    if is_device_loss(e):
+        raise DeviceLostError(
+            f"device loss at {seam!r}: {e}", device_id=device_id,
+            seam=seam) from e

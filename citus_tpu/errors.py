@@ -92,8 +92,53 @@ class AdmissionRejected(CitusTpuError):
     the-client error, never a half-executed statement."""
 
 
+class PlacementLostError(CatalogError):
+    """A shard has placements, but none on a live node: every copy sits
+    on nodes that are disabled or marked dead by the mesh health ledger
+    (device loss).  Subclasses CatalogError so existing callers keep
+    their semantics; the session's mesh-degrade path re-raises it as a
+    MeshDegradedError when devices have actually been lost, so an
+    unreplicated shard stranded on a dead device surfaces as the
+    device-loss terminal error it really is."""
+
+
 class ExecutionError(CitusTpuError):
     """Runtime failure during distributed execution."""
+
+
+class DeviceLostError(ExecutionError):
+    """A mesh device died, hung past its deadline, or errored
+    mid-statement — the TPU preemption / ICI-link-loss failure mode
+    (the reference's "connection to worker lost", classified there by
+    the adaptive executor as a task-level failover trigger).
+
+    Raised at the mesh seams (``mesh.device_put`` per-device transfer,
+    ``mesh.collective`` dispatch, ``mesh.fetch`` result pull) either by
+    the armed MeshSim (utils/faultinjection.py) or by wrapping a real
+    backend error that matches the device-loss signature
+    (distributed/mesh.py is_device_loss).  Classified by the session
+    retry envelope as *retryable-after-mesh-degrade*: the session marks
+    the device suspect in the catalog health ledger, rebuilds a
+    shrunken mesh from the survivors, re-plans through the node↔device
+    map (replicated shard placements fail over to surviving nodes) and
+    re-executes.  ``device_id`` is the failing jax device id when
+    known (None when a collective failed opaquely — the session then
+    probes the mesh to find the corpse); ``seam`` names where it
+    died."""
+
+    def __init__(self, message: str, device_id: int | None = None,
+                 seam: str | None = None):
+        self.device_id = device_id
+        self.seam = seam
+        super().__init__(message)
+
+
+class MeshDegradedError(DeviceLostError):
+    """Device loss that cannot be failed over: no surviving devices, a
+    shard whose only placement (shard_replication_factor=1) sits on the
+    dead device, or the failover budget is spent.  The clean,
+    client-facing terminal error of the mesh-degrade path — never wrong
+    rows, never a hung process."""
 
 
 class ResourceExhausted(ExecutionError):

@@ -222,6 +222,22 @@ class DeviceMemoryAccountant:
                 self._live_by_dev.extend(
                     [0] * (n - len(self._live_by_dev)))
 
+    def resize_mesh(self, n_dev: int) -> None:
+        """Re-size the per-device budget axis after an elastic shrink
+        or device-loss failover (Executor.adopt_mesh): hot-device
+        enforcement and the per-device ledger now span the SURVIVING
+        width, so a MemSim/hbm budget is judged against the mesh that
+        actually executes.  The ledger vector keeps its old tail so
+        charges recorded under the wider mesh still release exactly
+        what they added; _note_mesh grows it again if the mesh ever
+        widens back."""
+        n = max(1, int(n_dev))
+        with self._mu:
+            self._n_dev = n
+            if n > len(self._live_by_dev):
+                self._live_by_dev.extend(
+                    [0] * (n - len(self._live_by_dev)))
+
     def recharge(self, handle: int, category: str) -> None:
         """Move a live charge to another category (pipelined feed
         columns graduate prefetch → feed/cache on adoption).  A handle

@@ -346,6 +346,12 @@ class Executor:
             # trip on remote-attached TPUs)
             import jax
 
+            from ..distributed.mesh import (
+                is_device_loss,
+                mesh_device_check,
+                mesh_device_ids,
+            )
+            from ..errors import DeviceLostError
             from .hbm import is_resource_exhausted
 
             # XLA allocates the program's static intermediates where
@@ -354,9 +360,27 @@ class Executor:
             # for exactly the execution window
             est_per_dev = _plan_buffer_bytes(plan, caps) \
                 // max(1, plan.n_devices)
+
+            def _dispatch():
+                # mesh seams: a device dying mid-collective kills the
+                # dispatch; a device dying between dispatch and the
+                # device→host pull poisons the fetch.  Both are named
+                # fault points AND MeshSim checkpoints, so the whole
+                # kill-mid-query failover path is drivable on a CPU
+                # test mesh (distributed/mesh.py)
+                dev_ids = mesh_device_ids(self.mesh)
+                fault_point("mesh.collective")
+                mesh_device_check("mesh.collective", dev_ids)
+                out = fn(*feed_arrays)
+                fault_point("mesh.fetch")
+                mesh_device_check("mesh.fetch", dev_ids)
+                return jax.device_get(out)
+
+            from ..utils.faultinjection import fault_point
+
             try:
                 with self.accountant.lease("plan", est_per_dev):
-                    packed, overflow = jax.device_get(fn(*feed_arrays))
+                    packed, overflow = _dispatch()
             except jax.errors.JaxRuntimeError as e:
                 if is_resource_exhausted(e):
                     # the canonical accelerator failure: classify it so
@@ -367,6 +391,15 @@ class Executor:
                         f"device allocator OOM executing plan "
                         f"(~{est_per_dev} intermediate bytes/device): "
                         f"{e}") from e
+                if is_device_loss(e):
+                    # a device (or its ICI link) died under the
+                    # compiled program: classify it so the session
+                    # retry envelope shrinks the mesh and fails over
+                    # instead of dying (errors.DeviceLostError; the
+                    # session's probe pass identifies WHICH device)
+                    raise DeviceLostError(
+                        f"device loss executing plan: {e}",
+                        seam="mesh.collective") from e
                 # remote-attached compile services flake transiently on
                 # long compilations (connection drops mid-response); one
                 # clean retry re-issues the compile.  Anything else, or a
@@ -374,7 +407,7 @@ class Executor:
                 if "remote_compile" not in str(e):
                     raise
                 with self.accountant.lease("plan", est_per_dev):
-                    packed, overflow = jax.device_get(fn(*feed_arrays))
+                    packed, overflow = _dispatch()
             ov = np.asarray(overflow).reshape(-1, 2 + len(stage_keys))
             cap_overflow = int(ov[:, 0].sum())
             dense_oob = int(ov[:, 1].sum())
@@ -466,6 +499,23 @@ class Executor:
                         f"~{room} remain of the {budget}-byte device "
                         "budget — degrading instead of retrying into "
                         "a guaranteed OOM")
+
+    # ------------------------------------------------------------------
+    def adopt_mesh(self, mesh: Mesh) -> None:
+        """Swap in a (usually shrunken) mesh after device loss or an
+        elastic resize — the session's mesh-degrade path calls this
+        after rebuilding the mesh from survivors.  Compiled executables
+        and cache-resident feeds reference the dead device's buffers,
+        so both caches drop wholesale (plans re-key on the new
+        n_devices anyway; the caps memo keys on n_devices too, so
+        converged sizes for other widths stay warm).  Statements
+        already in flight on the old mesh object finish there — fake
+        and surviving real devices keep answering for them — and their
+        next retry re-plans onto this mesh."""
+        self.mesh = mesh
+        self.plan_cache.clear()
+        self.feed_cache.clear()
+        self.accountant.resize_mesh(mesh.devices.size)
 
     # ------------------------------------------------------------------
     def _plan_degradable(self, plan: QueryPlan) -> bool:

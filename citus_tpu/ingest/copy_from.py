@@ -285,10 +285,18 @@ def _ingest_batch(session, table: str, columns: list[str],
             if commit:
                 session.store.commit_pending(table, pending)
                 pending = []
-        except Exception:
+        except Exception as e:
             # a failed later shard must not leak the earlier shards'
-            # already-written (invisible) stripe files
-            session.store.discard_pending(table, pending)
+            # already-written (invisible) stripe files.  But a
+            # POST-VISIBILITY failure (change-log emit runs after
+            # commit_pending's manifest flip, cdc/feed.py tags it)
+            # leaves the stripes COMMITTED — discarding would unlink
+            # files the manifest references, i.e. silent data loss the
+            # next reader trips over as a missing-stripe read error
+            # (found by the chaos soak's cdc.append + device-killer
+            # interleaving)
+            if not getattr(e, "post_visibility", False):
+                session.store.discard_pending(table, pending)
             raise
         finally:
             if lock_txid is not None:
@@ -312,8 +320,12 @@ def _ingest_batch(session, table: str, columns: list[str],
             if commit:
                 session.store.commit_pending(table, pending)
                 pending = []
-        except Exception:
-            session.store.discard_pending(table, pending)
+        except Exception as e:
+            # post-visibility failures leave the batch committed: the
+            # discard would delete manifest-referenced stripe files
+            # (same rule as the hash path above)
+            if not getattr(e, "post_visibility", False):
+                session.store.discard_pending(table, pending)
             raise
     if stage_txn:
         session.txn_manager.current.stage_dml(table, {}, pending)

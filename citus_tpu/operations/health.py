@@ -41,7 +41,14 @@ def probe_node(session, node) -> bool:
             import jax
             import jax.numpy as jnp
 
-            out = jax.device_put(jnp.ones((), jnp.int32), devices[idx])  # graftlint: ignore[raw-device-placement] — 4-byte single-device health probe; charging it would make the probe depend on the ledger it may be diagnosing
+            from ..utils.faultinjection import mesh_device_check
+
+            # the MeshSim seam first: a killed fake device must fail
+            # this probe exactly like a dead real one, so the
+            # maintenance daemon's health_sweep is a second (background)
+            # device-loss detector beside the statement retry envelope
+            mesh_device_check("mesh.device_put", (devices[idx].id,))
+            out = jax.device_put(jnp.ones((), jnp.int32), devices[idx])  # graftlint: ignore[mesh-seam, raw-device-placement] — 4-byte single-device health probe through the MeshSim check above; charging it would make the probe depend on the ledger it may be diagnosing
             if int(out) != 1:
                 return False
         # storage probe: an actual DISK read of a shard directory this

@@ -15,6 +15,39 @@ from ..errors import CatalogError
 from ..storage import TableStore
 
 
+def move_placement(catalog: Catalog, store: TableStore,
+                   placement_id: int, target_node_name: str) -> bool:
+    """Move ONE specific placement to another node (the drain path).
+
+    Unlike move_shard_placement — which moves whichever replica is the
+    shard's PRIMARY (lowest placement id) — this retires exactly the
+    given copy: a node drain must bury the LEAVING node's replica, not
+    the healthy primary that happens to sort first (moving the primary
+    left the leaving node's copy active, and a replication-2 shard
+    could end with both copies on one node).  Storage is shared within
+    the single-host store, so only the catalog flips.  Returns True
+    when a move happened."""
+    target = catalog.node_by_name(target_node_name)
+    from ..utils.faultinjection import fault_point
+
+    with catalog._lock:
+        # same seam contract as move_shard_placement: a death before
+        # the flip leaves the old placement active
+        fault_point("operations.shard_move")
+        p = catalog.placements.get(placement_id)
+        if p is None:
+            raise CatalogError(
+                f"placement {placement_id} does not exist")
+        if p.node_id == target.node_id or p.shard_state != "active":
+            return False
+        p.shard_state = "to_delete"
+        pid = catalog.allocate_placement_id()
+        catalog.placements[pid] = ShardPlacement(pid, p.shard_id,
+                                                 target.node_id)
+        catalog._bump()
+        return True
+
+
 def move_shard_placement(catalog: Catalog, store: TableStore,
                          shard_id: int, target_node_name: str,
                          colocated: bool = True) -> list[int]:

@@ -56,6 +56,14 @@ FAILOVERS_TOTAL = "failovers_total"
 TIMEOUTS_TOTAL = "timeouts_total"
 QUERIES_CANCELED = "queries_canceled"
 FAULTS_INJECTED_TOTAL = "faults_injected_total"
+# mesh fault tolerance (session mesh-degrade path): devices observed
+# lost, successful shrink-and-failover passes, and statements that
+# ultimately ANSWERED because a failover rescued them (the
+# kill-to-first-answer numerator bench_multichip's device_loss
+# scenario publishes)
+DEVICE_LOST_TOTAL = "device_lost_total"
+MESH_FAILOVERS_TOTAL = "mesh_failovers_total"
+QUERIES_RESCUED_TOTAL = "queries_rescued_total"
 # workload manager (wlm/manager.py admission gate)
 WLM_ADMITTED_TOTAL = "wlm_admitted_total"
 WLM_QUEUED_TOTAL = "wlm_queued_total"
@@ -96,6 +104,7 @@ ALL_COUNTERS = [
     DEVICE_DECODED_BYTES_TOTAL,
     RETRIES_TOTAL, FAILOVERS_TOTAL, TIMEOUTS_TOTAL, QUERIES_CANCELED,
     FAULTS_INJECTED_TOTAL,
+    DEVICE_LOST_TOTAL, MESH_FAILOVERS_TOTAL, QUERIES_RESCUED_TOTAL,
     WLM_ADMITTED_TOTAL, WLM_QUEUED_TOTAL, WLM_SHED_TOTAL,
     WLM_QUEUE_WAIT_MS,
     SERVING_BATCHED_LOOKUPS_TOTAL, SERVING_BATCH_DISPATCH_TOTAL,

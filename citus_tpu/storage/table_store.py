@@ -110,6 +110,19 @@ class TableStore:
     def _manifest_path(self, table: str) -> str:
         return os.path.join(self.table_dir(table), "MANIFEST.json")
 
+    @staticmethod
+    def _stat_identity(path: str) -> tuple | None:
+        """The manifest's on-disk identity (mtime_ns, size, inode) —
+        THE cross-session staleness fact every comparison below keys
+        on; one helper so the fields can never drift between the
+        record, refresh and serving-backstop sites.  None when the
+        file is missing/unreadable."""
+        try:
+            st = os.stat(path)
+            return (st.st_mtime_ns, st.st_size, st.st_ino)
+        except OSError:
+            return None
+
     def _verify_enabled(self) -> bool:
         if self.settings is None:
             return True
@@ -121,10 +134,26 @@ class TableStore:
             if table not in self._manifests:
                 path = self._manifest_path(table)
                 if os.path.exists(path):
+                    # identity BEFORE content: another session's commit
+                    # can rename a new manifest between our read and a
+                    # stat.  Stat-first pairs the cached identity with
+                    # content AT LEAST as new, so the worst case is one
+                    # redundant refresh_if_stale reload.  The old
+                    # read-then-stat order could pair a NEW identity
+                    # with OLD content — every later staleness check
+                    # then compared new == new and the reader served
+                    # old rows forever (and poisoned the shared serving
+                    # result cache with a fresh-token stale fill; found
+                    # by the serving invalidation hammer once PR 13's
+                    # mesh seams shifted thread timing).
+                    ident = self._stat_identity(path)
                     # CRC-verified load: a flipped bit in the manifest
                     # must fail loudly, never route reads at garbage
                     self._manifests[table] = dio.read_json_checked(path)
-                    self._record_manifest_stat(table)
+                    if ident is not None:
+                        self._manifest_stats[table] = ident
+                    else:
+                        self._manifest_stats.pop(table, None)
                 else:
                     self._manifests[table] = {"next_stripe": 1, "shards": {}}
                     self._manifest_stats.pop(table, None)
@@ -163,15 +192,16 @@ class TableStore:
             self._record_manifest_stat(table)
 
     def _record_manifest_stat(self, table: str) -> None:
-        """Remember the on-disk manifest's identity (caller holds lock)."""
-        try:
-            st = os.stat(self._manifest_path(table))
-            # inode included: atomic_write_json renames a fresh file per
-            # commit, so two same-size commits inside one mtime tick
-            # still change identity (review: lost-visibility hole)
-            self._manifest_stats[table] = (st.st_mtime_ns, st.st_size,
-                                           st.st_ino)
-        except OSError:
+        """Remember the on-disk manifest's identity (caller holds lock
+        AND the table write lock — only the writer may stat AFTER its
+        own commit; readers record a PRE-read stat via manifest()).
+        Inode included: atomic_write_json renames a fresh file per
+        commit, so two same-size commits inside one mtime tick still
+        change identity (review: lost-visibility hole)."""
+        ident = self._stat_identity(self._manifest_path(table))
+        if ident is not None:
+            self._manifest_stats[table] = ident
+        else:
             self._manifest_stats.pop(table, None)
 
     def refresh_if_stale(self, table: str) -> bool:
@@ -184,11 +214,7 @@ class TableStore:
         with self._lock:
             if table not in self._manifests:
                 return False  # next read loads from disk anyway
-            try:
-                st = os.stat(self._manifest_path(table))
-                disk = (st.st_mtime_ns, st.st_size, st.st_ino)
-            except OSError:
-                disk = None
+            disk = self._stat_identity(self._manifest_path(table))
             if self._manifest_stats.get(table) == disk:
                 return False
             self._manifests.pop(table, None)
@@ -220,11 +246,7 @@ class TableStore:
         every hit — the backstop for mutations the CDC journal missed
         (a crash in the post-visibility cdc.append window, out-of-band
         restore surgery)."""
-        try:
-            st = os.stat(self._manifest_path(table))
-            return (st.st_mtime_ns, st.st_size, st.st_ino)
-        except OSError:
-            return None
+        return self._stat_identity(self._manifest_path(table))
 
     def refresh(self, table: str) -> None:
         """Drop the cached manifest so the next read reloads from disk —

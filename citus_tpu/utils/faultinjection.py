@@ -83,6 +83,16 @@ FAULT_POINTS: dict[str, str] = {
     "executor.hbm_exhausted":
         "executor/hbm.py — accounted placement seam (arm with "
         "error='oom' for a synthetic allocator RESOURCE_EXHAUSTED)",
+    "mesh.device_put":
+        "distributed/mesh.py — per-device host→HBM transfer (arm with "
+        "error='device' for a synthetic device loss; MeshSim kills "
+        "chosen devices here)",
+    "mesh.collective":
+        "executor/runner.py — compiled collective dispatch onto the "
+        "mesh (a device dying mid-all_to_all kills the step)",
+    "mesh.fetch":
+        "executor/runner.py — device→host result fetch (the last seam "
+        "a dying device can poison)",
     "executor.repartition_shuffle":
         "executor/insert_select.py — INSERT..SELECT repartition write",
     "stream.prefetch": "executor/stream.py — batch prefetch thread",
@@ -144,6 +154,16 @@ def fault_point(name: str) -> None:
     if kind == "storage":
         exc: Exception = StorageError(
             f"injected storage fault at {name!r}")
+    elif kind == "device":
+        # the mesh failure kind: classified by the session retry
+        # envelope as retryable-after-mesh-degrade (shrink the mesh
+        # onto survivors, fail shard reads over to replica placements)
+        # — device_id None models the opaque collective failure, so
+        # the session's probe pass has to find the corpse (errors.py)
+        from ..errors import DeviceLostError
+
+        exc = DeviceLostError(
+            f"injected device loss at {name!r}", seam=name)
     elif kind == "oom":
         # the device-allocator failure kind: classified by the session
         # retry envelope as retryable-after-degradation, so an armed
@@ -164,9 +184,9 @@ def arm(name: str, after: int = 0, once: bool = True,
         error: str | None = "injected", seed: int | None = None) -> None:
     """Arm `name`.  `times` (trigger count before disarm) overrides
     `once`; `once=False, times=None` stays armed forever.  `error` picks
-    the raised kind ('injected' | 'storage' | 'oom') or None for
-    delay-only."""
-    if error not in (None, "injected", "storage", "oom"):
+    the raised kind ('injected' | 'storage' | 'oom' | 'device') or None
+    for delay-only."""
+    if error not in (None, "injected", "storage", "oom", "device"):
         raise ValueError(f"unknown fault error kind {error!r}")
     with _lock:
         _armed[name] = {
@@ -196,14 +216,111 @@ def inject(name: str, after: int = 0, once: bool = True,
         disarm(name)
 
 
+class MeshSim:
+    """One simulated mesh-failure lifetime — the MemSim/CrashSim pattern
+    applied to the device dimension.  A real TPU loses devices at three
+    seams: the per-device host→HBM transfer, the collective dispatch,
+    and the result fetch; ``distributed/mesh.py`` consults the armed
+    sim at exactly those seams (mesh.device_put / mesh.collective /
+    mesh.fetch) via :func:`mesh_device_check`.
+
+    * ``kill``  — sticky dead devices (by jax device id): EVERY seam
+      that touches one raises DeviceLostError until the sim is
+      uninstalled — the preempted-chip model;
+    * ``error`` — one-shot: the first touch raises, then the device
+      recovers — the transient-link-flap model;
+    * ``hang``  — device id → seconds: the seam sleeps first (pair
+      with statement_timeout_ms to model a hung chip that never
+      answers — the deadline, not the sim, ends the statement);
+    * ``after`` — skip the first N seam checks before the sim
+      activates, so a kill lands MID-query (after the feed, before
+      the fetch) instead of on the first touch.
+    """
+
+    def __init__(self, kill=(), error=(), hang=None, after: int = 0):
+        self.kill = set(kill)
+        self.error = set(error)
+        self.hang = dict(hang or {})
+        self.after = after
+        self.checks = 0
+        self.trips = 0
+
+
+_mesh_sim: MeshSim | None = None
+
+
+def install_mesh_sim(sim: MeshSim | None) -> None:
+    global _mesh_sim
+    with _lock:
+        _mesh_sim = sim
+
+
+def mesh_sim() -> MeshSim | None:
+    return _mesh_sim
+
+
+@contextlib.contextmanager
+def simulate_mesh(kill=(), error=(), hang=None, after: int = 0):
+    """Arm a MeshSim for the duration of the block (the chaos soak's
+    device-killer actor and the bench device_loss scenario drive this;
+    `kill`/`error` take jax device ids)."""
+    sim = MeshSim(kill=kill, error=error, hang=hang, after=after)
+    install_mesh_sim(sim)
+    try:
+        yield sim
+    finally:
+        install_mesh_sim(None)
+
+
+def mesh_device_check(seam: str, device_ids) -> None:
+    """Called at the mesh seams with the jax device ids the operation
+    touches; raises DeviceLostError for the first killed/erroring
+    device the armed MeshSim names.  Unarmed cost: one None check."""
+    sim = _mesh_sim
+    if sim is None:
+        return
+    with _lock:
+        if _mesh_sim is not sim:
+            return
+        sim.checks += 1
+        if sim.checks <= sim.after:
+            return
+        sleep = max((sim.hang.get(d, 0.0) for d in device_ids),
+                    default=0.0)
+        victim = next((d for d in device_ids if d in sim.kill), None)
+        transient = None
+        if victim is None:
+            transient = next((d for d in device_ids if d in sim.error),
+                             None)
+            if transient is not None:
+                sim.error.discard(transient)
+        if victim is not None or transient is not None:
+            sim.trips += 1
+    if sleep:
+        time.sleep(sleep)  # hung device (outside the lock)
+    dead = victim if victim is not None else transient
+    if dead is None:
+        return
+    from ..errors import DeviceLostError
+
+    exc = DeviceLostError(
+        f"device {dead} lost at {seam!r} "
+        f"({'killed' if victim is not None else 'transient error'}, "
+        "MeshSim)", device_id=dead, seam=seam)
+    exc.injected_fault = True
+    raise exc
+
+
 def armed_points() -> list[str]:
     with _lock:
         return sorted(_armed)
 
 
 def reset() -> None:
+    global _mesh_sim
     with _lock:
         _armed.clear()
+        _mesh_sim = None
 
 
 def main(argv: list[str] | None = None) -> int:

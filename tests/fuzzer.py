@@ -633,6 +633,24 @@ def generate_serving(rng: random.Random, state: dict) -> tuple:
     return ("read", rng.choice(SERVING_READ_AGGS), None)
 
 
+def chaos_device_kill(rng: random.Random, device_ids) -> dict:
+    """Device-killer actor (chaos mode): pick a victim device and how
+    the mesh loses it — sticky kill (preempted chip) or one-shot
+    transient error (link flap) — plus a small `after` so the loss
+    lands MID-statement (after some seam trips, not on the first
+    touch).  The soak harness arms a MeshSim
+    (utils/faultinjection.simulate_mesh) with this spec around one op;
+    the invariant is unchanged: oracle-identical rows via failover or
+    a clean CitusTpuError, never wrong rows or a hang."""
+    victim = rng.choice(sorted(device_ids))
+    spec = {"after": rng.randrange(0, 5)}
+    if rng.random() < 0.35:
+        spec["error"] = {victim}  # transient: recovers after one trip
+    else:
+        spec["kill"] = {victim}  # sticky: dead until the op ends
+    return spec
+
+
 def generate_chaos(rng: random.Random, state: dict,
                    model: dict) -> list[ChaosStmt]:
     """One chaos operation → 1..4 statements (transactions span several).

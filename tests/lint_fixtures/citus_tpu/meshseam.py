@@ -1,0 +1,23 @@
+"""mesh-seam fixtures: device-targeted transfers bypassing
+distributed/mesh.py (where the mesh.device_put fault point, the MeshSim
+device-loss checks and the DeviceLostError classification live)."""
+
+import jax
+
+
+def bad_targeted_put(arr, device):
+    return jax.device_put(arr, device)
+
+
+def bad_targeted_put_kw(arr, device):
+    return jax.device_put(arr, device=device)
+
+
+def fine_untargeted(arr):
+    # no explicit target: commits nothing to a specific device (still
+    # raw-device-placement's business, flagged there)
+    return jax.device_put(arr)
+
+
+def fine_ignored(arr, device):
+    return jax.device_put(arr, device)  # graftlint: ignore[mesh-seam, raw-device-placement] — fixture: sanctioned single-device probe

@@ -23,4 +23,4 @@ def fine_accounted(accountant, mesh, arr):
 
 
 def fine_ignored(arr, device):
-    return jax.device_put(arr, device)  # graftlint: ignore[raw-device-placement] — fixture: sanctioned probe
+    return jax.device_put(arr, device)  # graftlint: ignore[raw-device-placement, mesh-seam] — fixture: sanctioned probe

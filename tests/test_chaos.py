@@ -33,7 +33,7 @@ import pytest
 import citus_tpu
 from citus_tpu.errors import CitusTpuError
 from citus_tpu.utils import faultinjection as fi
-from fuzzer import generate_chaos
+from fuzzer import chaos_device_kill, generate_chaos
 
 pytestmark = pytest.mark.chaos
 
@@ -89,6 +89,16 @@ FAULT_POOL = [
     dict(name="executor.scan_prefetch"),
     dict(name="executor.scan_prefetch", p=0.5, times=2),
     dict(name="executor.device_decode"),
+    # mesh seams (PR 13): an armed error='device' raises a
+    # DeviceLostError that names no corpse — the session's probe pass
+    # must find every fake device alive (a link flap) and re-run on
+    # the intact mesh; the REAL kills come from the MeshSim
+    # device-killer actor below, which buries a chosen device so the
+    # session shrinks its mesh and fails shard reads over to replicas
+    dict(name="mesh.collective", error="device"),
+    dict(name="mesh.fetch", error="device"),
+    dict(name="mesh.device_put", error="device"),
+    dict(name="mesh.collective", error="device", p=0.5, times=2),
 ]
 
 
@@ -155,16 +165,33 @@ def _run_soak_inner(tmp_path, n_ops: int, seed: int, fault_rate: float):
     model.update(seed_rows)
 
     stats = {"ops": 0, "stmts": 0, "armed": 0, "clean_failures": 0,
-             "reconciled": 0}
+             "reconciled": 0, "device_kills": 0}
+    # device-killer victims: ids >= 2 only — the 2-device sessions own
+    # ids {0, 1} and the reconcile/checksum paths run through them, so
+    # the 8-device session takes the losses (and shrinks across the
+    # soak) while the narrow sessions stay un-killable
+    import jax as _jax
+
+    kill_pool = [d.id for d in _jax.devices() if d.id >= 2]
     while stats["ops"] < n_ops:
         stats["ops"] += 1
         sess = sessions[stats["ops"] % len(sessions)]
         script = generate_chaos(rng, state, model)
         armed = None
+        mesh_armed = False
         if rng.random() < fault_rate:
             spec = dict(rng.choice(FAULT_POOL))
             armed = spec.pop("name")
             fi.arm(armed, seed=rng.randrange(1 << 30), **spec)
+            stats["armed"] += 1
+        elif kill_pool and rng.random() < 0.12:
+            # the device-killer actor: bury (or flap) one fake device
+            # for the duration of this op — the widest session's mesh
+            # shrinks and fails over; everyone must stay oracle-clean
+            kspec = chaos_device_kill(rng, kill_pool)
+            fi.install_mesh_sim(fi.MeshSim(**kspec))
+            mesh_armed = True
+            stats["device_kills"] += 1
             stats["armed"] += 1
         in_txn = False
         try:
@@ -219,6 +246,8 @@ def _run_soak_inner(tmp_path, n_ops: int, seed: int, fault_rate: float):
         finally:
             if armed is not None:
                 fi.disarm(armed)
+            if mesh_armed:
+                fi.install_mesh_sim(None)
     # ---- post-soak: store uncorrupted ------------------------------------
     for sess in sessions:
         committed, discarded = sess.txn_manager.recover()

@@ -758,6 +758,29 @@ class TestRetryClassificationEdges:
         assert int(sess.execute(
             "SELECT count(*) FROM kv").rows()[0][0]) == 50
 
+    def test_post_visibility_insert_fault_keeps_committed_stripes(
+            self, tmp_data_dir):
+        """Regression (found by the chaos soak's cdc.append +
+        device-killer interleaving): cdc.append fires AFTER
+        commit_pending's manifest flip, so the INSERT's batch IS
+        committed when the error escapes — the ingest error path used
+        to discard_pending anyway, unlinking stripe files the manifest
+        references.  With replication 1 the next reader of the shard
+        died on FileNotFoundError (silent data loss surfacing as an
+        unclean error)."""
+        sess = citus_tpu.connect(data_dir=tmp_data_dir,
+                                 retry_backoff_base_ms=1)
+        sess.execute("CREATE TABLE kv (id INT, v INT)")
+        sess.execute("SELECT create_distributed_table('kv', 'id', 2)")
+        sess.execute("INSERT INTO kv VALUES (1, 10), (2, 20)")
+        with inject("cdc.append"):
+            with pytest.raises(InjectedFault):
+                sess.execute("INSERT INTO kv VALUES (3, 30), (4, 40)")
+        # post-visibility: the rows are committed AND their stripe
+        # files still exist — the full-table read must succeed
+        r = sess.execute("SELECT count(*), sum(v) FROM kv")
+        assert tuple(map(int, r.rows()[0])) == (4, 100)
+
     def test_real_oserror_in_change_log_not_retried(self, tmp_data_dir):
         # a REAL OSError escaping ChangeLog.emit (post-manifest-flip) is
         # tagged post-visibility and must not be retried even though

@@ -89,6 +89,15 @@ GOLDEN = {
     ("raw-device-placement", "citus_tpu/rawplace.py", 9),
     ("raw-device-placement", "citus_tpu/rawplace.py", 13),
     ("raw-device-placement", "citus_tpu/rawplace.py", 17),
+    # a device-TARGETED put outside distributed/mesh.py trips BOTH
+    # placement rules: it bypasses the accounted seam AND the mesh
+    # fault/DeviceLostError seam
+    ("mesh-seam", "citus_tpu/rawplace.py", 9),
+    ("mesh-seam", "citus_tpu/meshseam.py", 9),
+    ("mesh-seam", "citus_tpu/meshseam.py", 13),
+    ("raw-device-placement", "citus_tpu/meshseam.py", 9),
+    ("raw-device-placement", "citus_tpu/meshseam.py", 13),
+    ("raw-device-placement", "citus_tpu/meshseam.py", 19),
     ("host-sync-in-traced", "citus_tpu/executor/hot.py", 12),
     ("host-sync-in-traced", "citus_tpu/executor/hot.py", 13),
     ("host-sync-in-traced", "citus_tpu/executor/hot.py", 14),
@@ -136,7 +145,7 @@ def test_each_rule_family_has_a_firing_fixture():
         "discipline": {"bare-except", "swallowed-base-exception",
                        "swallowed-fault-seam", "silent-exception",
                        "unowned-thread", "raw-durable-write",
-                       "raw-device-placement"},
+                       "raw-device-placement", "mesh-seam"},
     }
     for family, expected in families.items():
         assert expected <= rules, f"family {family} missing fixtures"

@@ -1,0 +1,500 @@
+"""Mesh fault tolerance (PR 13): device-loss detection, query-level
+failover, and elastic shrink.
+
+The reference treats node failure as routine: connection errors mark
+placements suspect and the adaptive executor fails tasks over to
+replica placements (adaptive_executor.c:95-116, connection_management).
+Here the "node" is a mesh device, so the failure unit is a TPU chip
+dying/hanging/erroring mid-collective — the MeshSim layer
+(utils/faultinjection.py) injects exactly that at the three seams a
+real device fails (mesh.device_put / mesh.collective / mesh.fetch),
+and these tests pin the contract:
+
+    a mid-query device kill either returns oracle-identical rows via
+    shrink-and-failover (shard_replication_factor >= 2) or raises a
+    clean DeviceLostError-derived error (replication 1) — never wrong
+    rows, never a hung process.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import citus_tpu
+from citus_tpu.errors import (
+    CatalogError,
+    DeviceLostError,
+    ExecutionError,
+    MeshDegradedError,
+    StatementTimeout,
+)
+from citus_tpu.stats import counters as sc
+from citus_tpu.utils import faultinjection as fi
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    fi.reset()
+    yield
+    fi.reset()
+
+
+def _mk(data_dir, **kw):
+    return citus_tpu.connect(
+        data_dir=str(data_dir), retry_backoff_base_ms=1,
+        retry_backoff_max_ms=5, serving_result_cache_bytes=0, **kw)
+
+
+def _seed_kv(sess, n=2000, shard_count=4):
+    sess.execute("CREATE TABLE kv (id INT, v INT)")
+    sess.execute(
+        f"SELECT create_distributed_table('kv', 'id', {shard_count})")
+    sess.execute("INSERT INTO kv VALUES " + ", ".join(
+        f"({i}, {i * 3})" for i in range(n)))
+    return n
+
+
+def _mesh_ids(sess):
+    return [d.id for d in sess.mesh.devices.flat]
+
+
+# ---------------------------------------------------------------------------
+# MeshSim + the mesh.* seams
+
+
+class TestMeshSimSeams:
+    def test_kill_raises_classified_at_device_put(self):
+        from citus_tpu.distributed.mesh import (
+            make_mesh,
+            put_sharded_slices,
+        )
+
+        mesh = make_mesh(4)
+        ids = [d.id for d in mesh.devices.flat]
+        slices = [np.zeros(128, np.int64) for _ in range(4)]
+        with fi.simulate_mesh(kill={ids[2]}):
+            with pytest.raises(DeviceLostError) as ei:
+                put_sharded_slices(mesh, slices)
+        assert ei.value.device_id == ids[2]
+        assert ei.value.seam == "mesh.device_put"
+
+    def test_transient_error_fires_once_then_recovers(self):
+        from citus_tpu.distributed.mesh import make_mesh, put_sharded
+
+        mesh = make_mesh(2)
+        ids = [d.id for d in mesh.devices.flat]
+        arr = np.zeros((2, 64), np.int64)
+        with fi.simulate_mesh(error={ids[1]}):
+            with pytest.raises(DeviceLostError):
+                put_sharded(mesh, arr)
+            out = put_sharded(mesh, arr)  # device recovered
+            assert out.shape == (2, 64)
+
+    def test_probe_finds_the_corpse(self):
+        from citus_tpu.distributed.mesh import (
+            make_mesh,
+            probe_mesh_devices,
+        )
+
+        mesh = make_mesh(4)
+        ids = [d.id for d in mesh.devices.flat]
+        assert probe_mesh_devices(mesh) == []
+        with fi.simulate_mesh(kill={ids[1], ids[3]}):
+            assert sorted(probe_mesh_devices(mesh)) == sorted(
+                [ids[1], ids[3]])
+
+    def test_shape_validation_rejects_mismatched_slices(self):
+        """Satellite regression: mismatched per-device slice shapes
+        used to assemble a corrupt global array (or die later in an
+        opaque XLA shape error) — now a classified error at the seam."""
+        from citus_tpu.distributed.mesh import (
+            make_mesh,
+            put_sharded_slices,
+        )
+
+        mesh = make_mesh(4)
+        slices = [np.zeros(128, np.int64) for _ in range(3)]
+        slices.append(np.zeros(64, np.int64))  # short slice
+        with pytest.raises(ExecutionError, match="slice 3 has shape"):
+            put_sharded_slices(mesh, slices)
+
+    def test_mesh_without_builds_survivor_mesh(self):
+        from citus_tpu.distributed.mesh import make_mesh, mesh_without
+
+        mesh = make_mesh(4)
+        ids = [d.id for d in mesh.devices.flat]
+        small = mesh_without(mesh, {ids[1]})
+        assert small.devices.size == 3
+        assert ids[1] not in [d.id for d in small.devices.flat]
+        assert mesh_without(mesh, set(ids)) is None
+
+
+# ---------------------------------------------------------------------------
+# fault-point kinds at the new registry entries
+
+
+class TestMeshFaultPoints:
+    def test_collective_device_fault_transient_rerun(self, tmp_path):
+        """An armed error='device' at mesh.collective names no corpse;
+        the probe pass finds every device alive (a link flap) and the
+        statement re-runs on the SAME mesh — no shrink."""
+        sess = _mk(tmp_path / "d", n_devices=2)
+        try:
+            n = _seed_kv(sess)
+            with fi.inject("mesh.collective", error="device"):
+                r = sess.execute("select count(*), sum(v) from kv")
+            assert r.rows()[0] == (n, sum(i * 3 for i in range(n)))
+            snap = sess.stats.counters.snapshot()
+            assert snap[sc.DEVICE_LOST_TOTAL] == 1
+            assert snap[sc.MESH_FAILOVERS_TOTAL] == 0
+            assert sess.n_devices == 2  # transient: mesh intact
+        finally:
+            sess.close()
+
+    def test_fetch_device_fault_transient_rerun(self, tmp_path):
+        sess = _mk(tmp_path / "d", n_devices=2)
+        try:
+            n = _seed_kv(sess)
+            with fi.inject("mesh.fetch", error="device"):
+                r = sess.execute("select count(*) from kv")
+            assert int(r.rows()[0][0]) == n
+            assert sess.stats.counters.snapshot()[
+                sc.DEVICE_LOST_TOTAL] == 1
+        finally:
+            sess.close()
+
+    def test_device_put_fault_transient_rerun(self, tmp_path):
+        sess = _mk(tmp_path / "d", n_devices=2)
+        try:
+            n = _seed_kv(sess)
+            sess.executor.feed_cache.clear()  # the seam must re-fire
+            with fi.inject("mesh.device_put", error="device"):
+                r = sess.execute("select count(*) from kv")
+            assert int(r.rows()[0][0]) == n
+            assert sess.stats.counters.snapshot()[
+                sc.DEVICE_LOST_TOTAL] == 1
+        finally:
+            sess.close()
+
+    def test_mesh_failover_off_raises_immediately(self, tmp_path):
+        sess = _mk(tmp_path / "d", n_devices=2, mesh_failover=False)
+        try:
+            _seed_kv(sess)
+            with fi.inject("mesh.collective", error="device"):
+                with pytest.raises(DeviceLostError):
+                    sess.execute("select count(*) from kv")
+        finally:
+            sess.close()
+
+
+# ---------------------------------------------------------------------------
+# query-level failover
+
+
+class TestDeviceLossFailover:
+    def test_kill_mid_query_fails_over_to_replicas(self, tmp_path):
+        """THE acceptance contract, replication >= 2: a device killed
+        mid-statement shrinks the mesh, re-routes the dead node's
+        shards onto surviving replica placements, and the statement
+        answers oracle-identically."""
+        sess = _mk(tmp_path / "d", n_devices=4,
+                   shard_replication_factor=2)
+        try:
+            n = _seed_kv(sess, n=2000, shard_count=4)
+            want = sess.execute(
+                "select count(*), sum(v) from kv").rows()[0]
+            victim = _mesh_ids(sess)[2]
+            with fi.simulate_mesh(kill={victim}, after=1):
+                r = sess.execute("select count(*), sum(v) from kv")
+            assert r.rows()[0] == want
+            assert sess.n_devices == 3
+            snap = sess.stats.counters.snapshot()
+            assert snap[sc.DEVICE_LOST_TOTAL] >= 1
+            assert snap[sc.MESH_FAILOVERS_TOTAL] == 1
+            assert snap[sc.QUERIES_RESCUED_TOTAL] == 1
+            # the shrunken mesh keeps answering after the sim clears
+            r = sess.execute("select id, v from kv where v % 7 = 0")
+            assert r.row_count == sum(1 for i in range(n)
+                                      if (i * 3) % 7 == 0)
+        finally:
+            sess.close()
+
+    def test_replication_one_ends_in_clean_derived_error(self, tmp_path):
+        """Replication 1: the dead device's shards have no surviving
+        placement — the statement must end in a DeviceLostError-derived
+        clean error, never wrong rows (and never a hang)."""
+        sess = _mk(tmp_path / "d", n_devices=4,
+                   shard_replication_factor=1)
+        try:
+            _seed_kv(sess, n=800, shard_count=4)
+            sess.execute("CREATE TABLE ref (k INT, lbl INT)")
+            sess.execute("SELECT create_reference_table('ref')")
+            sess.execute("INSERT INTO ref VALUES (1, 10), (2, 20)")
+            victim = _mesh_ids(sess)[1]
+            with fi.simulate_mesh(kill={victim}):
+                with pytest.raises(MeshDegradedError):
+                    sess.execute("select count(*), sum(v) from kv")
+                # still inside the outage: the unreplicated table stays
+                # cleanly unroutable...
+                with pytest.raises(MeshDegradedError):
+                    sess.execute("select count(*) from kv")
+            # ...while a reference table (replicated on every node)
+            # keeps answering on the shrunken mesh
+            r = sess.execute("select count(*), sum(lbl) from ref")
+            assert r.rows()[0] == (2, 30)
+            # health surfaces tell the story
+            r = sess.execute("select citus_stat_mesh()")
+            row = dict(zip(r.column_names, r.rows()[0]))
+            states = json.loads(row["device_states"])
+            assert states[str(victim)] == "dead"
+            assert row["dead_nodes"] >= 1
+        finally:
+            sess.close()
+
+    def test_total_mesh_loss_is_unsurvivable(self, tmp_path):
+        sess = _mk(tmp_path / "d", n_devices=1,
+                   shard_replication_factor=2)
+        try:
+            _seed_kv(sess, n=200, shard_count=2)
+            with fi.simulate_mesh(kill=set(_mesh_ids(sess))):
+                with pytest.raises(MeshDegradedError,
+                                   match="no surviving"):
+                    sess.execute("select count(*) from kv")
+        finally:
+            sess.close()
+
+    def test_hung_device_ends_in_statement_timeout(self, tmp_path):
+        """A hanging (not dead) device must not hang the statement:
+        the cooperative deadline fires at the next seam."""
+        sess = _mk(tmp_path / "d", n_devices=2)
+        try:
+            _seed_kv(sess, n=500, shard_count=2)
+            sess.execute("SET statement_timeout_ms = 60")
+            victim = _mesh_ids(sess)[1]
+            with fi.simulate_mesh(hang={victim: 0.05}):
+                with pytest.raises(StatementTimeout):
+                    sess.execute("select count(*), sum(v) from kv")
+            sess.execute("SET statement_timeout_ms = 0")
+            assert sess.stats.counters.snapshot()[sc.TIMEOUTS_TOTAL] == 1
+        finally:
+            sess.close()
+
+    def test_explain_resilience_line_carries_mesh_counters(
+            self, tmp_path):
+        sess = _mk(tmp_path / "d", n_devices=2,
+                   shard_replication_factor=2)
+        try:
+            _seed_kv(sess, n=400, shard_count=2)
+            r = sess.execute("EXPLAIN ANALYZE SELECT count(*) FROM kv")
+            line = [x for x in r.columns["QUERY PLAN"]
+                    if x.startswith("Resilience:")][0]
+            assert "devices_lost=0" in line
+            assert "mesh_failovers=0" in line
+            assert "device_lost_total=" in line
+            assert "queries_rescued_total=" in line
+        finally:
+            sess.close()
+
+    def test_health_sweep_detects_killed_device(self, tmp_path):
+        """Second detection path: the maintenance daemon's health sweep
+        probes every node's device through the MeshSim seam, so a dead
+        fake device disables its node exactly like a dead real one."""
+        from citus_tpu.operations.health import health_sweep
+
+        sess = _mk(tmp_path / "d", n_devices=2,
+                   shard_replication_factor=2)
+        try:
+            _seed_kv(sess, n=300, shard_count=2)
+            victim = _mesh_ids(sess)[1]
+            with fi.simulate_mesh(kill={victim}):
+                disabled = health_sweep(sess)
+            assert disabled == ["device:1"]
+            # reads fail over through active_placement immediately
+            r = sess.execute("select count(*) from kv")
+            assert int(r.rows()[0][0]) == 300
+            sess.execute("select citus_activate_node('device:1')")
+        finally:
+            sess.close()
+
+
+# ---------------------------------------------------------------------------
+# elastic shrink + drain
+
+
+class TestElasticShrink:
+    def test_rebalance_mesh_shrink_migrates_off_surplus_nodes(
+            self, tmp_path):
+        """Satellite regression: rebalance_mesh(M < current) was a
+        SILENT no-op (the node loop only added).  Now the surplus
+        nodes drain onto the kept ones and leave the catalog."""
+        data_dir = str(tmp_path / "d")
+        s8 = _mk(data_dir, n_devices=8)
+        n = _seed_kv(s8, n=3000, shard_count=8)
+        want = s8.execute("select count(*), sum(v) from kv").rows()[0]
+        s8.close()
+
+        s2 = _mk(data_dir, n_devices=2)
+        try:
+            assert len(s2.catalog.active_nodes()) == 8
+            r = s2.execute("select citus_rebalance_mesh()")
+            row = dict(zip(r.column_names, r.rows()[0]))
+            assert row["nodes_added"] == 0
+            assert row["shards_moved"] > 0
+            assert len(s2.catalog.active_nodes()) == 2
+            assert s2.execute(
+                "select count(*), sum(v) from kv").rows()[0] == want
+            # idempotent: nothing left to drain or spread
+            r2 = s2.execute("select citus_rebalance_mesh()")
+            row2 = dict(zip(r2.column_names, r2.rows()[0]))
+            assert row2["nodes_added"] == 0 and row2["shards_moved"] == 0
+        finally:
+            s2.close()
+
+    def test_shrink_preserves_replicas_up_to_node_count(self, tmp_path):
+        """Replication 2 shrinking 4→2 keeps 2 distinct placements per
+        shard (one per surviving node), never two copies on one node."""
+        data_dir = str(tmp_path / "d")
+        s4 = _mk(data_dir, n_devices=4, shard_replication_factor=2)
+        _seed_kv(s4, n=1000, shard_count=4)
+        s4.close()
+        s2 = _mk(data_dir, n_devices=2)
+        try:
+            s2.execute("select citus_rebalance_mesh()")
+            kept = {nd.node_id for nd in s2.catalog.active_nodes()}
+            assert len(kept) == 2
+            for s in s2.catalog.table_shards("kv"):
+                nodes = [p.node_id for p in
+                         s2.catalog.shard_placements(s.shard_id)]
+                assert len(nodes) == len(set(nodes))  # no doubling
+                assert set(nodes) <= kept
+            assert s2.execute(
+                "select count(*) from kv").rows()[0][0] == 1000
+        finally:
+            s2.close()
+
+    def test_drain_device_migrates_and_parks_the_device(self, tmp_path):
+        sess = _mk(tmp_path / "d", n_devices=4)
+        try:
+            from citus_tpu.planner.plan import table_placement
+
+            n = _seed_kv(sess, n=1500, shard_count=4)
+            want = sess.execute(
+                "select count(*), sum(v) from kv").rows()[0]
+            r = sess.execute("select citus_drain_device(2)")
+            row = dict(zip(r.column_names, r.rows()[0]))
+            assert row["nodes_drained"] == 1
+            assert row["placements_moved"] >= 1
+            placement = table_placement(sess.catalog, "kv",
+                                        sess.n_devices)
+            assert 2 not in set(placement)
+            assert sess.execute(
+                "select count(*), sum(v) from kv").rows()[0] == want
+            r = sess.execute("select citus_stat_mesh()")
+            states = json.loads(dict(zip(
+                r.column_names, r.rows()[0]))["device_states"])
+            assert states[str(_mesh_ids(sess)[2])] == "dead"
+        finally:
+            sess.close()
+
+    def test_drain_preserves_local_table_only_placement(self, tmp_path):
+        """Review regression: a LOCAL table's single shard looks like a
+        reference shard (min_value None) but holds its ONLY placement —
+        the drain used to drop it as a 'surplus replica', stranding the
+        table permanently unreadable."""
+        sess = _mk(tmp_path / "d", n_devices=2)
+        try:
+            sess.execute("CREATE TABLE loc (id INT, v INT)")  # local
+            sess.execute("INSERT INTO loc VALUES (1, 10), (2, 20)")
+            _seed_kv(sess, n=300, shard_count=2)
+            # the local table's placement sits on node 1 → device 0
+            sess.execute("select citus_drain_device(0)")
+            r = sess.execute("select count(*), sum(v) from loc")
+            assert tuple(map(int, r.rows()[0])) == (2, 30)
+        finally:
+            sess.close()
+
+    def test_shrink_preserves_local_table_only_placement(self, tmp_path):
+        data_dir = str(tmp_path / "d")
+        s4 = _mk(data_dir, n_devices=4)
+        s4.execute("CREATE TABLE loc (id INT, v INT)")
+        s4.execute("INSERT INTO loc VALUES (5, 50)")
+        _seed_kv(s4, n=400, shard_count=4)
+        s4.close()
+        s1 = _mk(data_dir, n_devices=1)
+        try:
+            s1.execute("select citus_rebalance_mesh()")
+            assert len(s1.catalog.active_nodes()) == 1
+            r = s1.execute("select count(*), sum(v) from loc")
+            assert tuple(map(int, r.rows()[0])) == (1, 50)
+        finally:
+            s1.close()
+
+    def test_drain_last_device_refuses(self, tmp_path):
+        sess = _mk(tmp_path / "d", n_devices=1)
+        try:
+            _seed_kv(sess, n=100, shard_count=2)
+            with pytest.raises(CatalogError):
+                sess.execute("select citus_drain_device(0)")
+        finally:
+            sess.close()
+
+
+# ---------------------------------------------------------------------------
+# tier-1 chaos smoke: kill one of 4 devices mid-Q3
+
+
+@pytest.mark.chaos
+def test_q3_smoke_device_kill_mid_query(tmp_path):
+    """Chaos smoke slice (tier-1): a Q3-shaped 3-table join with
+    grouped aggregation + ORDER/LIMIT over a replication-2 cluster on
+    a 4-device mesh; one device dies MID-query (after= lands the kill
+    between the feeds and the fetch) and the statement must answer
+    oracle-identical rows through the failover."""
+    sess = _mk(tmp_path / "d", n_devices=4, shard_replication_factor=2)
+    try:
+        rng = np.random.default_rng(7)
+        sess.execute("CREATE TABLE customer (c_custkey INT, c_seg INT)")
+        sess.execute(
+            "SELECT create_distributed_table('customer', 'c_custkey', 4)")
+        sess.execute(
+            "CREATE TABLE orders (o_orderkey INT, o_custkey INT, "
+            "o_date INT, o_prio INT)")
+        sess.execute(
+            "SELECT create_distributed_table('orders', 'o_orderkey', 4)")
+        sess.execute(
+            "CREATE TABLE lineitem (l_orderkey INT, l_price INT, "
+            "l_date INT)")
+        sess.execute(
+            "SELECT create_distributed_table('lineitem', "
+            "'l_orderkey', 4)")
+        sess.execute("INSERT INTO customer VALUES " + ", ".join(
+            f"({i}, {i % 5})" for i in range(300)))
+        sess.execute("INSERT INTO orders VALUES " + ", ".join(
+            f"({i}, {int(rng.integers(300))}, {int(rng.integers(100))},"
+            f" {i % 3})" for i in range(900)))
+        sess.execute("INSERT INTO lineitem VALUES " + ", ".join(
+            f"({int(rng.integers(900))}, {int(rng.integers(1000))}, "
+            f"{int(rng.integers(100))})" for i in range(2500)))
+        q3 = ("select l_orderkey, sum(l_price), o_date, o_prio "
+              "from customer, orders, lineitem "
+              "where c_seg = 1 and c_custkey = o_custkey "
+              "and l_orderkey = o_orderkey and o_date < 50 "
+              "and l_date > 25 "
+              "group by l_orderkey, o_date, o_prio "
+              "order by 2 desc, l_orderkey limit 10")
+        want = sess.execute(q3).rows()
+        assert want  # the oracle run found rows
+        victim = _mesh_ids(sess)[3]
+        # after=1 skips the collective check: feeds are warm, so the
+        # kill lands at mesh.fetch — the program RAN and its result
+        # died on the wire, the genuinely mid-query moment
+        with fi.simulate_mesh(kill={victim}, after=1):
+            got = sess.execute(q3).rows()
+        assert got == want, "failover changed the answer"
+        snap = sess.stats.counters.snapshot()
+        assert snap[sc.MESH_FAILOVERS_TOTAL] >= 1
+        assert snap[sc.QUERIES_RESCUED_TOTAL] >= 1
+        assert sess.n_devices == 3
+    finally:
+        sess.close()

@@ -746,3 +746,52 @@ def test_manifest_identity_strictly_monotone(tmp_path):
     assert s2.execute("SELECT v FROM kv WHERE id = 1").rows() == [(11,)]
     sess.close()
     s2.close()
+
+
+def test_manifest_load_records_pre_read_identity(tmp_path):
+    """Companion race to the monotone-identity fix above (found by the
+    serving invalidation hammer once PR 13's mesh seams shifted thread
+    timing): `TableStore.manifest()` used to read the manifest CONTENT
+    and then stat the file to record its identity.  A commit renaming a
+    new manifest between those two steps paired the NEW identity with
+    the OLD content — every later refresh_if_stale compared new == new
+    and the reader served old rows forever (and poisoned the shared
+    serving result cache with a fresh-token stale fill).  The identity
+    is now recorded from a stat taken BEFORE the read, so a mid-read
+    commit costs one redundant reload instead of permanent blindness.
+    Force the exact interleaving by committing from a writer session
+    inside the reader's content read."""
+    data_dir = str(tmp_path / "preread")
+    w = citus_tpu.connect(data_dir=data_dir, n_devices=2)
+    w.execute("CREATE TABLE kv (id INT, v INT)")
+    w.execute("SELECT create_distributed_table('kv', 'id', 2)")
+    w.execute("INSERT INTO kv VALUES (1, 10)")
+
+    r = citus_tpu.connect(data_dir=data_dir, n_devices=2,
+                          serving_result_cache_bytes=0)
+    from citus_tpu.storage import table_store as ts
+
+    orig = ts.dio.read_json_checked
+    manifest_path = r.store._manifest_path("kv")
+    fired = {"n": 0}
+
+    def racing_read(path, *a, **kw):
+        content = orig(path, *a, **kw)
+        if path == manifest_path and fired["n"] == 0:
+            fired["n"] = 1
+            # the racing commit lands AFTER the reader's content read
+            # but BEFORE it returns (i.e. before any post-read stat)
+            w.execute("UPDATE kv SET v = 99 WHERE id = 1")
+        return content
+
+    ts.dio.read_json_checked = racing_read
+    try:
+        # this read loads the pre-update manifest content mid-race
+        r.execute("SELECT v FROM kv WHERE id = 1")
+    finally:
+        ts.dio.read_json_checked = orig
+    assert fired["n"] == 1, "race window never exercised"
+    # the next read must DETECT the racing commit and serve v=99
+    assert r.execute("SELECT v FROM kv WHERE id = 1").rows() == [(99,)]
+    w.close()
+    r.close()
