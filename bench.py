@@ -65,12 +65,95 @@ def bench_query(sess, sql: str, rows_processed: int, repeats: int):
     sess.execute(sql)  # warmup: compile + populate caches
     best = float("inf")
     result = None
-    for _ in range(repeats):
-        t0 = time.perf_counter()
-        result = sess.execute(sql)
-        best = min(best, time.perf_counter() - t0)
+    # measured reps always record a span tree (the fast-class
+    # auto-degrade must not sample out the very run whose trace the
+    # artifact keys derive from)
+    with sess.settings.override(trace_fast_statement_ms=0):
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            result = sess.execute(sql)
+            best = min(best, time.perf_counter() - t0)
     assert result is not None and result.row_count > 0
     return rows_processed / best, best
+
+
+def trace_phase_keys(doc, wall_seconds=None, sql=None):
+    """phase_*_seconds derived FROM THE SPAN TRACE of a measured run
+    (stats/tracing.py) — the drivers used to hand-roll these from
+    ScanPhaseStats timers; deriving them from the same trace EXPLAIN
+    ANALYZE renders makes artifact and EXPLAIN agree by construction.
+    Stamps phase_source="trace" so test_bench_artifacts can gate README
+    phase-attribution quotes on trace-derived keys.
+
+    `sql`: the measured statement — when the recorder's fast-class
+    auto-degrade sampled THIS run's tree out, last_trace() returns an
+    OLDER statement's trace; pairing its walls with this run's wall
+    clock would stamp wrong numbers under the provenance tag, so a
+    mismatched doc stamps nothing."""
+    from citus_tpu.stats.tracing import clamp_sql, span_seconds
+
+    if doc is None or (sql is not None
+                       and doc.get("sql") != clamp_sql(sql)):
+        return {}
+    root = doc["root"]
+    transfer = (span_seconds(root, "scan.transfer")
+                + span_seconds(root, "stream.transfer"))
+    out = {
+        "phase_source": "trace",
+        "phase_prefetch_decode_seconds": round(
+            span_seconds(root, "scan.prefetch")
+            + span_seconds(root, "stream.decode"), 4),
+        "phase_wire_encode_seconds": round(
+            span_seconds(root, "scan.wire_encode"), 4),
+        "phase_transfer_dispatch_seconds": round(transfer, 4),
+        "phase_device_decode_seconds": round(
+            span_seconds(root, "scan.device_decode"), 4),
+        "phase_compile_seconds": round(
+            span_seconds(root, "compile"), 4),
+        "phase_device_execute_seconds": round(
+            span_seconds(root, "mesh.dispatch")
+            + span_seconds(root, "mesh.fetch"), 4),
+    }
+    if wall_seconds:
+        out["transfer_wall_share"] = round(
+            min(1.0, transfer / wall_seconds), 4)
+    return out
+
+
+def trace_acceptance_keys(sess, export_path=None, sql=None):
+    """Acceptance evidence for the newest measured statement: the
+    top-level-spans-sum-to-wall share of ITS trace, p50/p99 of its
+    statement class from the DDSketch histograms, and (optionally) a
+    Chrome-trace JSON export next to the artifact.  `sql` guards
+    against last_trace() returning a different (auto-degrade-sampled)
+    statement's trace — see trace_phase_keys."""
+    from citus_tpu.stats.tracing import clamp_sql
+
+    doc = sess.stats.tracing.last_trace()
+    if doc is None or (sql is not None
+                       and doc.get("sql") != clamp_sql(sql)):
+        return {}
+    root = doc["root"]
+    top_ms = sum(c["dur_ms"] for c in root.get("children", ()))
+    out = {"trace_wall_ms": doc["wall_ms"],
+           "trace_top_span_share": (round(top_ms / root["dur_ms"], 4)
+                                    if root["dur_ms"] else None)}
+    cls = doc.get("class")  # traces carry their histogram key
+    for row in sess.stats.tracing.latency_rows():
+        if row["statement_class"] == cls:
+            out["trace_p50_ms"] = row["p50_ms"]
+            out["trace_p99_ms"] = row["p99_ms"]
+            out["trace_calls"] = row["calls"]
+            break
+    if export_path:
+        from citus_tpu.stats.trace_export import chrome_trace_events
+
+        payload = {"traceEvents": chrome_trace_events(doc),
+                   "displayTimeUnit": "ms"}
+        with open(export_path, "w") as f:
+            json.dump(payload, f, indent=1)
+        out["trace_export"] = os.path.basename(export_path)
+    return out
 
 
 def bench_cold_scan(sess, n_rows: int):
@@ -98,8 +181,11 @@ def bench_cold_scan(sess, n_rows: int):
     mode = resolve_scan_mode(sess.settings)
 
     def run_mode(m):
-        best, best_stats = float("inf"), {}
-        with sess.settings.override(scan_pipeline=m):
+        best, best_stats, best_doc = float("inf"), {}, None
+        # trace_fast_statement_ms=0: the measured rep's tree must
+        # exist — the phase keys below are derived from it
+        with sess.settings.override(scan_pipeline=m,
+                                    trace_fast_statement_ms=0):
             for _ in range(reps):
                 sess.executor.feed_cache.clear()
                 sess.executor.scan_stats.reset()
@@ -109,11 +195,12 @@ def bench_cold_scan(sess, n_rows: int):
                 if dt < best:
                     best = dt
                     best_stats = sess.executor.scan_stats.snapshot()
+                    best_doc = sess.stats.tracing.last_trace()
                 assert r.row_count == 1
-        return best, best_stats
+        return best, best_stats, best_doc
 
-    best, stats = run_mode(mode)
-    eager_best, _ = run_mode("off")
+    best, stats, doc = run_mode(mode)
+    eager_best, _, _ = run_mode("off")
     # host-only leg: same stripe read + decompress, no device
     cols = ["l_quantity", "l_extendedprice", "l_discount", "l_tax"]
     decode_best = float("inf")
@@ -140,27 +227,23 @@ def bench_cold_scan(sess, n_rows: int):
             max(0.0, eager_best - decode_best), 4),
         "bytes_decoded": decoded_bytes,
         "bytes_to_device": bytes_scanned,
-        # pipelined-scan phase breakdown (best pipelined rep)
+        # pipelined-scan phase breakdown (best pipelined rep): the
+        # phase_*_seconds walls come from the run's SPAN TRACE (the
+        # same spans EXPLAIN ANALYZE's Timing line renders), byte
+        # totals from ScanPhaseStats (the trace carries no byte
+        # ledger); phase_source stamps the provenance for the README
+        # honesty test
         "scan_pipeline": mode,
-        "phase_prefetch_decode_seconds": stats.get(
-            "prefetch_seconds", 0.0),
-        "phase_wire_encode_seconds": stats.get("decode_seconds", 0.0),
-        "phase_transfer_dispatch_seconds": stats.get(
-            "transfer_seconds", 0.0),
-        "phase_device_decode_seconds": stats.get(
-            "device_decode_seconds", 0.0),
         "prefetch_stalls": stats.get("prefetch_stalls", 0),
         "bytes_on_wire": stats.get("bytes_on_wire", 0),
         "bytes_decoded_pipeline": stats.get("bytes_decoded", 0),
         "wire_ratio": (round(stats["bytes_on_wire"]
                              / stats["bytes_decoded"], 4)
                        if stats.get("bytes_decoded") else None),
-        "transfer_wall_share": round(
-            min(1.0, stats.get("transfer_seconds", 0.0) / best), 4)
-        if best else None,
         "eager_seconds": round(eager_best, 4),
         "vs_eager": round(eager_best / best, 3) if best else None,
     }
+    parts.update(trace_phase_keys(doc, wall_seconds=best, sql=sql))
     return (bytes_scanned / best / 1e9, best, parts, reps,
             bytes_scanned / eager_best / 1e9, eager_best)
 
@@ -474,11 +557,23 @@ def bench_serving() -> None:
                 f"select o_totalprice from orders where o_orderkey = {k}")
         seed_sess.close()
 
-        def run_mode(name, serving_on, cache_on):
-            sessions = [Session(
-                data_dir=data_dir, serving_enabled=serving_on,
-                serving_result_cache_bytes=(256 << 20) if cache_on
-                else 0) for _ in range(n_sessions)]
+        def run_mode(name, serving_on, cache_on, trace_on=True,
+                     shared_sessions=None):
+            # `shared_sessions`: the trace-overhead A/B flips ONE knob
+            # on one warmed session set instead of rebuilding sessions
+            # per arm — fresh-session warmup variance (~8% run to run
+            # on this sandbox) would otherwise drown a ~1% effect
+            own = shared_sessions is None
+            if own:
+                sessions = [Session(
+                    data_dir=data_dir, serving_enabled=serving_on,
+                    trace_enabled=trace_on,
+                    serving_result_cache_bytes=(256 << 20) if cache_on
+                    else 0) for _ in range(n_sessions)]
+            else:
+                sessions = shared_sessions
+                for s in sessions:
+                    s.settings.set("trace_enabled", trace_on)
             for s in sessions:  # warm parse/plan caches off the clock
                 s.execute("select o_totalprice from orders "
                           f"where o_orderkey = {hot[0]}")
@@ -524,8 +619,9 @@ def bench_serving() -> None:
                 b0["batch_dispatch_total"]
             d_lk = b1["batched_lookups_total"] - \
                 b0["batched_lookups_total"]
-            for s in sessions:
-                s.close()
+            if own:
+                for s in sessions:
+                    s.close()
             lats.sort()
 
             def pct(p):
@@ -554,10 +650,70 @@ def bench_serving() -> None:
 
         for name, srv, cache in (
                 ("point_lookup_qps_baseline", False, False),
-                ("point_lookup_qps_batched", True, False),
-                ("point_lookup_qps", True, True)):
-            line = run_mode(name, srv, cache)
-            print(json.dumps(line), flush=True)
+                ("point_lookup_qps_batched", True, False)):
+            print(json.dumps(run_mode(name, srv, cache)), flush=True)
+        # span-recorder overhead A/B: the full serving stack traced vs
+        # trace_enabled=off, measured as paired order-alternating
+        # rounds over ONE warmed session set (flipping only the knob).
+        # Methodology matters more than the effect here: fresh
+        # sessions per arm plus a fixed order charged the sandbox's
+        # run-to-run drift to whichever arm ran first and "measured"
+        # the recorder at 13% — an overhead that flipped sign when the
+        # order flipped.  The always-on recorder must cost ≲2% of
+        # steady-state QPS (PERF_NOTES r16).
+        import statistics
+
+        ab_rounds = int(os.environ.get("BENCH_SRV_AB_ROUNDS", "4"))
+        if ab_rounds < 1:
+            # A/B disabled: still print the headline serving line the
+            # artifact contract expects
+            print(json.dumps(run_mode("point_lookup_qps", True, True)),
+                  flush=True)
+            return
+        ab_sessions = [Session(
+            data_dir=data_dir, serving_enabled=True,
+            serving_result_cache_bytes=256 << 20)
+            for _ in range(n_sessions)]
+        try:
+            on_lines, off_lines = [], []
+            for rnd in range(ab_rounds):
+                arms = [("point_lookup_qps", True),
+                        ("point_lookup_qps_trace_off", False)]
+                if rnd % 2:
+                    arms.reverse()
+                for aname, tr in arms:
+                    line = run_mode(aname, True, True, tr,
+                                    shared_sessions=ab_sessions)
+                    (on_lines if tr else off_lines).append(line)
+        finally:
+            for s in ab_sessions:
+                s.close()
+        on_best = max(on_lines, key=lambda x: x["value"])
+        off_best = max(off_lines, key=lambda x: x["value"])
+        # overhead from MEDIANS over the post-warmup rounds (a
+        # difference of noisy maxima is noisier than either; round 0
+        # is cold for both arms), plus the derived per-statement CPU
+        # cost in µs — the number that transfers off this sandbox:
+        # this scenario's cache-hit statement is ~0.4 ms of pure
+        # Python, so the share is its worst case; on any ≥2 ms
+        # statement the same µs is <2% of wall
+        med_on = statistics.median(
+            x["value"] for x in on_lines[1:] or on_lines)
+        med_off = statistics.median(
+            x["value"] for x in off_lines[1:] or off_lines)
+        if med_off:
+            off_best["trace_overhead_pct"] = round(
+                100.0 * (1.0 - med_on / med_off), 2)
+        if med_on and med_off:
+            # the hammer is GIL-bound: aggregate QPS ≈ one core's
+            # statement rate, so 1/QPS deltas are CPU-per-statement
+            off_best["trace_overhead_us_per_stmt"] = round(
+                (1.0 / med_on - 1.0 / med_off) * 1e6, 1)
+        off_best["trace_ab_rounds"] = ab_rounds
+        off_best["trace_ab_qps_on"] = [x["value"] for x in on_lines]
+        off_best["trace_ab_qps_off"] = [x["value"] for x in off_lines]
+        print(json.dumps(on_best), flush=True)
+        print(json.dumps(off_best), flush=True)
     finally:
         shutil.rmtree(data_dir, ignore_errors=True)
 
@@ -703,7 +859,12 @@ def main() -> None:
                 print(f"# budget: skipping {name}", file=sys.stderr)
                 continue
             rate, best = bench_query(sess, sql, rows, repeats)
-            emit(name, rate, best, sf, reps=repeats)
+            # Q3 carries the tracing acceptance evidence: top-level
+            # spans of the measured run's trace must tile its wall,
+            # and the DDSketch histogram quotes its p50/p99
+            extra = (trace_acceptance_keys(sess, sql=sql)
+                     if name == "tpch_q3_rows_per_sec" else None)
+            emit(name, rate, best, sf, reps=repeats, extra=extra)
         if ((only is None or "columnar_scan_gb_per_sec" in only)
                 and not over_budget(0.7)):
             (rate, best, parts, scan_reps,
@@ -812,8 +973,19 @@ def main() -> None:
                 r = n_reps(2)
                 rate, best = bench_query(
                     s10, QUERIES["Q3"], n_cust10 + n_ord10 + n_li10, r)
+                # the acceptance run: EXPLAIN-equal phase walls from
+                # the trace, a Chrome-trace export next to the
+                # artifacts, and the class's DDSketch p50/p99
+                extra = trace_phase_keys(
+                    s10.stats.tracing.last_trace(), wall_seconds=best,
+                    sql=QUERIES["Q3"])
+                extra.update(trace_acceptance_keys(
+                    s10, sql=QUERIES["Q3"],
+                    export_path=os.path.join(
+                        os.path.dirname(os.path.abspath(__file__)),
+                        "TRACE_sf10_q3.json")))
                 emit("tpch_q3_sf10_rows_per_sec", rate, best,
-                     sf10_scale, reps=r, sess_obj=s10)
+                     sf10_scale, reps=r, sess_obj=s10, extra=extra)
 
         # -- serving scenario (PR 8): the three point_lookup_qps lines
         #    land in the driver artifact so the README/PERF_NOTES

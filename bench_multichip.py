@@ -129,15 +129,18 @@ def _child(n_devices: int) -> None:
             feed_per_dev = max(by_dev) if by_dev else None
             snap0 = sess.stats.counters.snapshot()
             best = float("inf")
-            for _ in range(repeats):
-                t0 = time.perf_counter()
-                r = sess.execute(sql)
-                best = min(best, time.perf_counter() - t0)
-                assert r.row_count > 0
+            # measured reps always record a span tree (the phase keys
+            # stamped below derive from the last one)
+            with sess.settings.override(trace_fast_statement_ms=0):
+                for _ in range(repeats):
+                    t0 = time.perf_counter()
+                    r = sess.execute(sql)
+                    best = min(best, time.perf_counter() - t0)
+                    assert r.row_count > 0
             shuffle = (sess.stats.counters.snapshot().get(
                 sc.SHUFFLE_BYTES_TOTAL, 0)
                 - snap0.get(sc.SHUFFLE_BYTES_TOTAL, 0)) // repeats
-            print(json.dumps({
+            line = {
                 "metric": metric,
                 "n_devices": n_devices,
                 "value": round(rows / best, 1),
@@ -150,7 +153,15 @@ def _child(n_devices: int) -> None:
                 "shuffle_bytes": int(shuffle),
                 "platform": platform,
                 "host_fake_devices": platform == "cpu",
-            }), flush=True)
+            }
+            # phase walls of the last measured rep, derived from its
+            # span trace (bench.trace_phase_keys — same provenance as
+            # bench.py/bench_sf100.py, stamped phase_source="trace")
+            from bench import trace_phase_keys
+
+            line.update(trace_phase_keys(
+                sess.stats.tracing.last_trace(), sql=sql))
+            print(json.dumps(line), flush=True)
         if n_devices >= 2:
             # LAST (the failover shrinks this session's mesh): measured
             # kill-to-first-answer recovery under a mid-query device

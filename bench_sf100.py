@@ -71,12 +71,20 @@ def main():
         sess.executor.feed_cache.clear()
         sess.executor.scan_stats.reset()
         t0 = time.perf_counter()
-        r = sess.execute(sql)
+        # the measured run must record its span tree (phase keys
+        # below derive from it; auto-degrade must not sample it out)
+        with sess.settings.override(trace_fast_statement_ms=0):
+            r = sess.execute(sql)
         warm = time.perf_counter() - t0
         # per-phase walls + the bytes-on-wire ratio for the warm run:
         # "no longer transfer-bound" must be artifact-backed, not
-        # PERF_NOTES prose (stream_* legs come from the batched stream
-        # path, phase_* legs from pipelined resident feeds)
+        # PERF_NOTES prose.  The phase_*_seconds walls now come from
+        # the warm run's SPAN TRACE (stats/tracing.py — the same spans
+        # EXPLAIN ANALYZE's Timing line renders; scan.* legs from
+        # pipelined resident feeds, stream.* legs from the batched
+        # stream path), byte totals from ScanPhaseStats
+        from bench import trace_phase_keys
+
         ss = sess.executor.scan_stats.snapshot()
         line = {"metric": name, "value": round(rows / warm, 1),
                 "unit": "rows/s",
@@ -85,20 +93,14 @@ def main():
                 "sf": scale, "rows_out": r.row_count,
                 "streamed_batches": r.streamed_batches,
                 "scan_pipeline": resolve_scan_mode(sess.settings),
-                "phase_prefetch_decode_seconds": ss["prefetch_seconds"]
-                + ss["stream_decode_seconds"],
-                "phase_transfer_dispatch_seconds": ss["transfer_seconds"]
-                + ss["stream_transfer_seconds"],
-                "phase_device_decode_seconds":
-                    ss["device_decode_seconds"],
                 "bytes_on_wire": ss["bytes_on_wire"],
                 "bytes_decoded": ss["bytes_decoded"],
                 "wire_ratio": (round(ss["bytes_on_wire"]
                                      / ss["bytes_decoded"], 4)
-                               if ss["bytes_decoded"] else None),
-                "transfer_wall_share": round(min(
-                    1.0, (ss["transfer_seconds"]
-                          + ss["stream_transfer_seconds"]) / warm), 4)}
+                               if ss["bytes_decoded"] else None)}
+        line.update(trace_phase_keys(
+            sess.stats.tracing.last_trace(), wall_seconds=warm,
+            sql=sql))
         lines.append(line)
         print(json.dumps(line), flush=True)
 
@@ -110,10 +112,14 @@ def main():
             doc = json.load(f)
         doc.setdefault("published", {})
         for line in lines:
+            # .get: "note" was never stamped on any line, so the
+            # strict lookup made every publish die silently in the
+            # except below (pre-existing; found wiring the trace keys)
             doc["published"][line["metric"]] = {
-                k: line[k] for k in ("value", "vs_baseline", "sf",
-                                     "seconds", "cold_seconds",
-                                     "streamed_batches", "note")}
+                k: line.get(k) for k in ("value", "vs_baseline", "sf",
+                                         "seconds", "cold_seconds",
+                                         "streamed_batches",
+                                         "phase_source")}
         with open(path + ".tmp", "w") as f:
             json.dump(doc, f, indent=2)
         os.replace(path + ".tmp", path)

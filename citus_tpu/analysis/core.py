@@ -137,7 +137,8 @@ FAMILY_RULES = {
     "hotpath": frozenset({"host-sync-in-traced", "traced-python-branch",
                           "device-sync-in-loop", "jit-in-loop"}),
     "registries": frozenset({"fault-point-registry", "counter-registry",
-                             "config-registry", "explain-tag-registry"}),
+                             "config-registry", "explain-tag-registry",
+                             "span-registry"}),
     "discipline": frozenset({"bare-except", "swallowed-base-exception",
                              "swallowed-fault-seam", "silent-exception",
                              "unowned-thread", "raw-durable-write",

@@ -11,7 +11,12 @@ by scattered ad-hoc tests (or not at all):
   ``config.py`` vs every ``settings.get("name")`` / ``.set("name")``
   read/write site;
 * EXPLAIN tags — ``EXPLAIN_TAGS`` in ``planner/explain.py`` vs every
-  ``explain_tag("name")`` render site.
+  ``explain_tag("name")`` render site;
+* span names — ``SPAN_NAMES`` in ``stats/tracing.py`` vs every
+  ``trace_span("name")`` / ``span_name("name")`` record site (the
+  flight recorder's EXPLAIN_TAGS analogue: bench drivers and
+  trace_summarize key on these strings, so a silently renamed span is
+  a silently broken phase attribution).
 
 Both directions are findings: a name used but not registered is
 ``*-registry: unregistered``, a registered name never used is
@@ -30,6 +35,7 @@ FAULTINJECTION_MOD = "citus_tpu/utils/faultinjection.py"
 COUNTERS_MOD = "citus_tpu/stats/counters.py"
 CONFIG_MOD = "citus_tpu/config.py"
 EXPLAIN_MOD = "citus_tpu/planner/explain.py"
+TRACING_MOD = "citus_tpu/stats/tracing.py"
 
 
 # -- registry extraction (AST, no imports) ----------------------------------
@@ -258,6 +264,25 @@ def check(modules: list[Module], partial: bool = False) -> list[Finding]:
                 "explain-tag-registry", EXPLAIN_MOD, registry[name],
                 f"EXPLAIN tag {name!r} is registered but never "
                 "rendered via explain_tag()"))
+
+    # -- span names (stats/tracing.py flight recorder) ---------------------
+    tmod = by_path.get(TRACING_MOD)
+    if tmod is not None:
+        registry = _dict_literal_keys(tmod.tree, "SPAN_NAMES")
+        uses = (_str_arg_calls(modules, "trace_span")
+                + _str_arg_calls(modules, "span_name"))
+        used = {u[0] for u in uses}
+        for name, path, line, ctx in sorted(uses):
+            if name not in registry:
+                findings.append(Finding(
+                    "span-registry", path, line,
+                    f"span name {name!r} is not declared in "
+                    "SPAN_NAMES (stats/tracing.py)", ctx))
+        for name in (() if partial else sorted(set(registry) - used)):
+            findings.append(Finding(
+                "span-registry", TRACING_MOD, registry[name],
+                f"span name {name!r} is registered but never recorded "
+                "via trace_span()/span_name()"))
     return findings
 
 

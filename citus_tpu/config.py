@@ -454,6 +454,60 @@ _register(ConfigVar(
     "Minimum relative improvement for a move to be worth it (ref 50%).",
     float, min_value=0.0, max_value=1.0))
 
+# --- tracing / observability (stats/tracing.py span flight recorder) ------
+_register(ConfigVar(
+    "trace_enabled", True,
+    "Always-on span flight recorder (stats/tracing.py): every "
+    "statement records a span tree (parse/queue/plan/compile/feed/"
+    "mesh/serving/retry phases, carried across producer threads), "
+    "folds its wall time into per-statement-class DDSketch latency "
+    "histograms (citus_stat_latency()), and keeps recent traces in a "
+    "bounded ring.  Off disables ALL recording (the bench overhead "
+    "A/B's comparison arm).  No direct reference GUC — the analogue "
+    "is pg_stat_statements + EXPLAIN ANALYZE timing always being on.",
+    bool))
+_register(ConfigVar(
+    "trace_ring_statements", 128,
+    "Completed statement traces kept in the in-memory ring (oldest "
+    "dropped; spans per trace are additionally capped, so trace "
+    "memory stays bounded under a many-session hammer).",
+    int, min_value=1, max_value=100_000))
+_register(ConfigVar(
+    "trace_slow_statement_ms", 5000,
+    "Statements slower than this persist their full span tree as "
+    "JSON under <data_dir>/slow_traces/ through the durable-write "
+    "seam (newest 32 kept; tools/trace_summarize.py prints the "
+    "newest one, python -m citus_tpu.stats.trace_export renders it "
+    "for chrome://tracing).  0 disables the slow-query log "
+    "(PostgreSQL log_min_duration_statement analogue).",
+    int, min_value=0, max_value=86_400_000))
+_register(ConfigVar(
+    "trace_sample_every", 1,
+    "Record a full span tree for 1 in N statements (histograms "
+    "always update).  1 = every statement; raise it if a workload "
+    "ever shows the recorder in its profile (PERF_NOTES round 16).",
+    int, min_value=1, max_value=1_000_000))
+_register(ConfigVar(
+    "trace_fast_statement_ms", 5.0,
+    "Auto-degrade threshold: statement classes whose OBSERVED mean "
+    "wall (DDSketch histogram, ≥8 calls) is below this record full "
+    "span trees only 1 in trace_fast_sample_every statements — "
+    "sub-ms cache-hit workloads would otherwise pay the recorder "
+    "~15% of pure-Python statement cost (span trees cost ~15 µs; "
+    "attribution of a 0.3 ms statement is rarely the question being "
+    "asked).  The default sits above the serving hammer's contended "
+    "walls (GIL waits inflate a 0.3 ms statement to ~3 ms of wall) "
+    "and below every statement class attribution exists for.  "
+    "Classes at or above the threshold, cold classes (<8 calls), and "
+    "every histogram update stay always-on.  0 disables the degrade "
+    "(every statement records a tree).",
+    float, min_value=0.0, max_value=60_000.0))
+_register(ConfigVar(
+    "trace_fast_sample_every", 16,
+    "Tree-recording sample rate for sub-threshold statement classes "
+    "(see trace_fast_statement_ms).",
+    int, min_value=1, max_value=1_000_000))
+
 # --- planner --------------------------------------------------------------
 _register(ConfigVar(
     "log_distributed_plans", False,

@@ -138,13 +138,16 @@ def try_execute_fast_path(executor, plan: QueryPlan, raw: bool):
                 node.rel.table, shards[0].shard_id)
         if total > max_rows:
             return None
-    cols, nulls, valid = _exec_host(executor, plan.root)
-    # host-combine expects a null mask per column (the device path
-    # always materializes them)
-    for cid, arr in cols.items():
-        if cid not in nulls:
-            nulls[cid] = np.zeros(arr.shape[0], dtype=bool)
-    result = executor._host_combine(plan, cols, nulls, valid, raw)
+    from ..stats.tracing import trace_span
+
+    with trace_span("fastpath"):
+        cols, nulls, valid = _exec_host(executor, plan.root)
+        # host-combine expects a null mask per column (the device path
+        # always materializes them)
+        for cid, arr in cols.items():
+            if cid not in nulls:
+                nulls[cid] = np.zeros(arr.shape[0], dtype=bool)
+        result = executor._host_combine(plan, cols, nulls, valid, raw)
     result.fast_path = True
     result.device_rows_scanned = 0
     return result

@@ -199,8 +199,11 @@ class Executor:
         packed, out_meta, caps, retries, feeds = self._run_resident(
             plan, compute_dtype)
         self.count_groupby_bucketed(plan, caps)
-        cols, nulls, valid = unpack_outputs(packed, out_meta)
-        result = self._host_combine(plan, cols, nulls, valid, raw)
+        from ..stats.tracing import trace_span
+
+        with trace_span("combine"):
+            cols, nulls, valid = unpack_outputs(packed, out_meta)
+            result = self._host_combine(plan, cols, nulls, valid, raw)
         result.retries = retries
         # result-transfer volume in row slots (n_dev·cap, or n_dev·k under
         # device top-k pushdown) — EXPLAIN ANALYZE / stats surface this
@@ -214,12 +217,16 @@ class Executor:
         """Resident-feed execution core: build feeds, resolve the
         capacity memo, run the overflow-retry loop.  Shared by
         execute_plan and the multipass pass driver."""
-        feeds = build_feeds(plan, self.catalog, self.store, self.mesh,
-                            compute_dtype, cache=self.feed_cache,
-                            counters=self.counters,
-                            accountant=self.accountant,
-                            no_cache_nodes=no_cache_nodes,
-                            stats=self.scan_stats)
+        from ..stats.tracing import trace_span
+
+        with trace_span("feed"):
+            feeds = build_feeds(plan, self.catalog, self.store,
+                                self.mesh, compute_dtype,
+                                cache=self.feed_cache,
+                                counters=self.counters,
+                                accountant=self.accountant,
+                                no_cache_nodes=no_cache_nodes,
+                                stats=self.scan_stats)
         # device_topk + its ORDER BY keys are traced into the program
         topk_sig = (plan.device_topk, tuple(
             (repr(e), d, nf) for e, d, nf in plan.host_order_by)
@@ -321,6 +328,8 @@ class Executor:
             # the capacity tables); probe_kernel only swaps the inner
             # formulation so it joins the key here
             group_kernel = self.settings.get("group_by_kernel")
+            from ..stats.tracing import trace_span
+
             key = fingerprint + (caps_signature(plan, caps), probe_kernel)
             entry = self.plan_cache.get(key)
             if entry is None:
@@ -329,18 +338,21 @@ class Executor:
                 # named seam: a failure while tracing/compiling must
                 # leave the plan cache without a half-built entry
                 fault_point("executor.plan_cache_fill")
-                compiler = PlanCompiler(plan, self.mesh, feeds, caps,
-                                        compute_dtype,
-                                        probe_kernel=probe_kernel,
-                                        group_kernel=group_kernel)
-                fn, feed_arrays, out_meta, stage_keys = compiler.build()
+                with trace_span("compile", cache="miss"):
+                    compiler = PlanCompiler(plan, self.mesh, feeds,
+                                            caps, compute_dtype,
+                                            probe_kernel=probe_kernel,
+                                            group_kernel=group_kernel)
+                    fn, feed_arrays, out_meta, stage_keys = \
+                        compiler.build()
                 shuffle_bytes = compiler.shuffle_bytes
                 self.plan_cache.put(key, (fn, out_meta, stage_keys,
                                           shuffle_bytes))
             else:
                 fn, out_meta, stage_keys, shuffle_bytes = entry
-                feed_arrays = flatten_feed_arrays(plan, feeds,
-                                                  compute_dtype)
+                with trace_span("compile", cache="hit"):
+                    feed_arrays = flatten_feed_arrays(plan, feeds,
+                                                      compute_dtype)
             # two device→host transfers total: the bit-packed output block
             # and the overflow counters (each transfer pays a full round
             # trip on remote-attached TPUs)
@@ -369,12 +381,14 @@ class Executor:
                 # kill-mid-query failover path is drivable on a CPU
                 # test mesh (distributed/mesh.py)
                 dev_ids = mesh_device_ids(self.mesh)
-                fault_point("mesh.collective")
-                mesh_device_check("mesh.collective", dev_ids)
-                out = fn(*feed_arrays)
-                fault_point("mesh.fetch")
-                mesh_device_check("mesh.fetch", dev_ids)
-                return jax.device_get(out)
+                with trace_span("mesh.dispatch"):
+                    fault_point("mesh.collective")
+                    mesh_device_check("mesh.collective", dev_ids)
+                    out = fn(*feed_arrays)
+                with trace_span("mesh.fetch"):
+                    fault_point("mesh.fetch")
+                    mesh_device_check("mesh.fetch", dev_ids)
+                    return jax.device_get(out)
 
             from ..utils.faultinjection import fault_point
 

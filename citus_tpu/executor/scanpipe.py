@@ -550,62 +550,73 @@ class _ScanPipeline:
     def _encode_and_place(self, ci: int, buf, nbuf):
         """Wire-encode (device mode) + place one column; returns the
         queue payload the consumer finishes."""
+        from ..stats.tracing import trace_span
+
         t0 = time.perf_counter()
         if self.mode != "device":
-            arr, h = self._place(buf, "prefetch")
-            payload = {"kind": "plain", "arr": arr, "handle": h,
-                       "wire": buf.nbytes, "decoded": buf.nbytes}
-            if nbuf is not None:
-                narr, nh = self._place(nbuf, "prefetch")
-                payload.update(nulls=narr, nulls_handle=nh,
-                               wire=payload["wire"] + nbuf.nbytes,
-                               decoded=payload["decoded"] + nbuf.nbytes)
+            with trace_span("scan.transfer"):
+                arr, h = self._place(buf, "prefetch")
+                payload = {"kind": "plain", "arr": arr, "handle": h,
+                           "wire": buf.nbytes, "decoded": buf.nbytes}
+                if nbuf is not None:
+                    narr, nh = self._place(nbuf, "prefetch")
+                    payload.update(
+                        nulls=narr, nulls_handle=nh,
+                        wire=payload["wire"] + nbuf.nbytes,
+                        decoded=payload["decoded"] + nbuf.nbytes)
             self._stat(transfer_seconds=time.perf_counter() - t0)
             return payload
-        kind, wire, extra = encode_column(buf)
+        with trace_span("scan.wire_encode"):
+            kind, wire, extra = encode_column(buf)
         t1 = time.perf_counter()
-        arr, h = self._place(wire, "prefetch")
-        payload = {"kind": kind, "arr": arr, "handle": h,
-                   "dtype": buf.dtype, "wire": wire.nbytes,
-                   "decoded": buf.nbytes}
-        if kind == "for":
-            payload["base"] = extra
-        elif kind == "dict":
-            lut, lh = self.acc.place_tracked(self.mesh, extra, False,
-                                             "prefetch")
-            payload.update(lut=lut, lut_handle=lh,
-                           wire=payload["wire"] + extra.nbytes)
-        if nbuf is not None:
-            packed = np.packbits(nbuf, axis=-1)
-            narr, nh = self._place(packed, "prefetch")
-            payload.update(nulls=narr, nulls_handle=nh, nulls_packed=True,
-                           wire=payload["wire"] + packed.nbytes,
-                           decoded=payload["decoded"] + nbuf.nbytes)
+        with trace_span("scan.transfer"):
+            arr, h = self._place(wire, "prefetch")
+            payload = {"kind": kind, "arr": arr, "handle": h,
+                       "dtype": buf.dtype, "wire": wire.nbytes,
+                       "decoded": buf.nbytes}
+            if kind == "for":
+                payload["base"] = extra
+            elif kind == "dict":
+                lut, lh = self.acc.place_tracked(self.mesh, extra,
+                                                 False, "prefetch")
+                payload.update(lut=lut, lut_handle=lh,
+                               wire=payload["wire"] + extra.nbytes)
+            if nbuf is not None:
+                packed = np.packbits(nbuf, axis=-1)
+                narr, nh = self._place(packed, "prefetch")
+                payload.update(nulls=narr, nulls_handle=nh,
+                               nulls_packed=True,
+                               wire=payload["wire"] + packed.nbytes,
+                               decoded=payload["decoded"] + nbuf.nbytes)
         self._stat(decode_seconds=t1 - t0,
                    transfer_seconds=time.perf_counter() - t1)
         return payload
 
     def _valid_payload(self):
+        from ..stats.tracing import trace_span
+
         t0 = time.perf_counter()
-        if self.mode == "device" and self.sharded:
-            rows = np.asarray(self.dev_rows,
-                              dtype=np.int32).reshape(-1, 1)
-            arr, h = self._place(rows, "prefetch")
-            payload = {"kind": "rows", "arr": arr, "handle": h,
-                       "wire": rows.nbytes,
-                       "decoded": len(self.dev_rows) * self.cap}
-        else:
-            if self.sharded:
-                valid = np.zeros((len(self.dev_rows), self.cap),
-                                 dtype=bool)
-                for d, r in enumerate(self.dev_rows):
-                    valid[d, :r] = True
+        with trace_span("scan.transfer"):
+            if self.mode == "device" and self.sharded:
+                rows = np.asarray(self.dev_rows,
+                                  dtype=np.int32).reshape(-1, 1)
+                arr, h = self._place(rows, "prefetch")
+                payload = {"kind": "rows", "arr": arr, "handle": h,
+                           "wire": rows.nbytes,
+                           "decoded": len(self.dev_rows) * self.cap}
             else:
-                valid = np.zeros(self.cap, dtype=bool)
-                valid[:self.dev_rows[0]] = True
-            arr, h = self._place(valid, "prefetch")
-            payload = {"kind": "plain", "arr": arr, "handle": h,
-                       "wire": valid.nbytes, "decoded": valid.nbytes}
+                if self.sharded:
+                    valid = np.zeros((len(self.dev_rows), self.cap),
+                                     dtype=bool)
+                    for d, r in enumerate(self.dev_rows):
+                        valid[d, :r] = True
+                else:
+                    valid = np.zeros(self.cap, dtype=bool)
+                    valid[:self.dev_rows[0]] = True
+                arr, h = self._place(valid, "prefetch")
+                payload = {"kind": "plain", "arr": arr, "handle": h,
+                           "wire": valid.nbytes,
+                           "decoded": valid.nbytes}
         self._stat(transfer_seconds=time.perf_counter() - t0)
         return payload
 
@@ -623,39 +634,51 @@ class _ScanPipeline:
         return False
 
     def _produce(self):
+        from ..stats.tracing import adopt_context, trace_span
         from ..utils.faultinjection import fault_point
 
-        try:
-            t0 = time.perf_counter()
-            # classification parity with the eager path: the feed-level
-            # placement seam fires here too, before any transfer starts
-            fault_point("executor.device_put")
-            pieces = self._first_pass()
-            self._stat(prefetch_seconds=time.perf_counter() - t0)
-            if self.colnames:
-                buf, nbuf = self._assemble(0, pieces)
-                del pieces
-                if not self._put(("col", self.node.columns[0],
-                                  self._encode_and_place(0, buf,
-                                                         nbuf))):
-                    return
-                del buf, nbuf
-            for ci in range(1, len(self.colnames)):
+        # the producer adopts the statement's trace context: its
+        # prefetch/encode/transfer spans nest under the span that was
+        # open when run() captured the token (the feed build), on the
+        # producer's own track — any span this thread leaves open is
+        # force-closed and counted by adopt_context on the way out
+        with adopt_context(self._trace_ctx):
+            try:
                 t0 = time.perf_counter()
-                buf, nbuf = self._assemble(ci)
+                # classification parity with the eager path: the
+                # feed-level placement seam fires here too, before any
+                # transfer starts
+                fault_point("executor.device_put")
+                with trace_span("scan.prefetch"):
+                    pieces = self._first_pass()
                 self._stat(prefetch_seconds=time.perf_counter() - t0)
-                if not self._put(("col", self.node.columns[ci],
-                                  self._encode_and_place(ci, buf,
-                                                         nbuf))):
+                if self.colnames:
+                    buf, nbuf = self._assemble(0, pieces)
+                    del pieces
+                    if not self._put(("col", self.node.columns[0],
+                                      self._encode_and_place(0, buf,
+                                                             nbuf))):
+                        return
+                    del buf, nbuf
+                for ci in range(1, len(self.colnames)):
+                    t0 = time.perf_counter()
+                    with trace_span("scan.prefetch"):
+                        buf, nbuf = self._assemble(ci)
+                    self._stat(
+                        prefetch_seconds=time.perf_counter() - t0)
+                    if not self._put(("col", self.node.columns[ci],
+                                      self._encode_and_place(ci, buf,
+                                                             nbuf))):
+                        return
+                    del buf, nbuf
+                if not self._put(("valid", None,
+                                  self._valid_payload())):
                     return
-                del buf, nbuf
-            if not self._put(("valid", None, self._valid_payload())):
-                return
-            self._put(("done", None, None))
-        except DeviceMemoryExhausted as e:
-            self._put(("shed", None, e))
-        except BaseException as e:  # graftlint: ignore[swallowed-base-exception] — not swallowed: forwarded over the queue and re-raised on the consumer thread
-            self._put(("err", None, e))
+                self._put(("done", None, None))
+            except DeviceMemoryExhausted as e:
+                self._put(("shed", None, e))
+            except BaseException as e:  # graftlint: ignore[swallowed-base-exception] — not swallowed: forwarded over the queue and re-raised on the consumer thread
+                self._put(("err", None, e))
 
     # -- consumer ----------------------------------------------------------
     def _finish_col(self, payload, category=None):
@@ -669,14 +692,17 @@ class _ScanPipeline:
                    bytes_decoded=payload["decoded"])
         kind = payload["kind"]
         decoded_nulls = None
+        from ..stats.tracing import trace_span
+
         if payload.get("nulls") is not None:
             if payload.get("nulls_packed"):
                 fault_point("executor.device_decode")
                 t0 = time.perf_counter()
-                decoded_nulls = _expand_bits(payload["nulls"], self.cap,
-                                             self.n_dev)
-                self.acc.adopt(decoded_nulls, self.sharded, self.n_dev,
-                               cat)
+                with trace_span("scan.device_decode"):
+                    decoded_nulls = _expand_bits(payload["nulls"],
+                                                 self.cap, self.n_dev)
+                    self.acc.adopt(decoded_nulls, self.sharded,
+                                   self.n_dev, cat)
                 self._stat(
                     device_decode_seconds=time.perf_counter() - t0)
                 self._count_decoded(decoded_nulls)
@@ -690,14 +716,15 @@ class _ScanPipeline:
         # surface as a clean statement error with the charge released
         fault_point("executor.device_decode")
         t0 = time.perf_counter()
-        if kind == "for":
-            decoded = _for_expand(payload["arr"], payload["base"])
-        elif kind == "dict":
-            decoded = _expand_dict(payload["arr"], payload["lut"],
-                                   self.n_dev)
-        else:  # rows → valid prefix
-            decoded = _valid_expand(payload["arr"], self.cap)
-        self.acc.adopt(decoded, self.sharded, self.n_dev, cat)
+        with trace_span("scan.device_decode"):
+            if kind == "for":
+                decoded = _for_expand(payload["arr"], payload["base"])
+            elif kind == "dict":
+                decoded = _expand_dict(payload["arr"], payload["lut"],
+                                       self.n_dev)
+            else:  # rows → valid prefix
+                decoded = _valid_expand(payload["arr"], self.cap)
+            self.acc.adopt(decoded, self.sharded, self.n_dev, cat)
         self._stat(device_decode_seconds=time.perf_counter() - t0)
         self._count_decoded(decoded)
         return decoded, decoded_nulls
@@ -710,9 +737,13 @@ class _ScanPipeline:
                                     int(arr.nbytes))
 
     def run(self):
+        from ..stats.tracing import capture_context
         from ..utils.cancellation import check_cancel
         from .compiler import FeedSpec
 
+        # hand the statement's trace context to the producer thread
+        # (None when nothing is being traced — adoption then no-ops)
+        self._trace_ctx = capture_context()
         t = threading.Thread(target=self._produce, daemon=True,
                              name="scan-prefetch")
         t.start()

@@ -327,10 +327,13 @@ class StreamBatcher:
         buffer allocation or device transfer, so exhaustion costs
         nothing.  Batch 0 always materializes (empty-table queries still
         need one execution)."""
+        from ..stats.tracing import trace_span
+
         node, rel = self.node, self.node.rel
         cap, n_dev = self.batch_cap, self.n_dev
         t_pull = time.perf_counter()
-        per_dev = [self._pull(d, cap) for d in range(n_dev)]
+        with trace_span("stream.decode"):
+            per_dev = [self._pull(d, cap) for d in range(n_dev)]
         if self.stats is not None:
             self.stats.add(
                 stream_decode_seconds=time.perf_counter() - t_pull)
@@ -375,9 +378,10 @@ class StreamBatcher:
                 self.mesh, [a[d] for d in range(self.n_dev)], "stream")
 
         t_put = time.perf_counter()
-        feed.arrays = {c: put(a) for c, a in feed.arrays.items()}
-        feed.nulls = {c: put(a) for c, a in feed.nulls.items()}
-        feed.valid = put(feed.valid)
+        with trace_span("stream.transfer"):
+            feed.arrays = {c: put(a) for c, a in feed.arrays.items()}
+            feed.nulls = {c: put(a) for c, a in feed.nulls.items()}
+            feed.valid = put(feed.valid)
         if self.stats is not None:
             self.stats.add(
                 stream_transfer_seconds=time.perf_counter() - t_put)
@@ -545,25 +549,34 @@ def try_execute_streamed(executor, plan: QueryPlan, raw: bool,
                 continue
         return False
 
+    from ..stats.tracing import adopt_context, capture_context
+
+    trace_ctx = capture_context()
+
     def producer():
         from ..utils.faultinjection import fault_point
 
-        try:
-            i = 0
-            while not stop_evt.is_set():
-                # named seam: a prefetch-thread death mid-stream must
-                # surface as a query error, never a hang or partial
-                # result (VERDICT r3 weak #6)
-                fault_point("stream.prefetch")
-                feed = batcher.feed(i)
-                if feed is None:
-                    break
-                if not _put(("ok", feed)):
-                    return
-                i += 1
-            _put(("done", None))
-        except BaseException as e:  # graftlint: ignore[swallowed-base-exception] — not swallowed: forwarded over the queue and re-raised on the consumer thread
-            _put(("err", e))
+        # the batch producer adopts the statement's trace context so
+        # its stream.decode/stream.transfer spans land on their own
+        # track of the statement trace (leak-proof: adopt_context
+        # force-closes anything left open)
+        with adopt_context(trace_ctx):
+            try:
+                i = 0
+                while not stop_evt.is_set():
+                    # named seam: a prefetch-thread death mid-stream
+                    # must surface as a query error, never a hang or
+                    # partial result (VERDICT r3 weak #6)
+                    fault_point("stream.prefetch")
+                    feed = batcher.feed(i)
+                    if feed is None:
+                        break
+                    if not _put(("ok", feed)):
+                        return
+                    i += 1
+                _put(("done", None))
+            except BaseException as e:  # graftlint: ignore[swallowed-base-exception] — not swallowed: forwarded over the queue and re-raised on the consumer thread
+                _put(("err", e))
 
     t = threading.Thread(target=producer, daemon=True)
     t.start()
@@ -614,13 +627,16 @@ def try_execute_streamed(executor, plan: QueryPlan, raw: bool,
             # compiled program, and per-batch actuals vary — tightening
             # on batch 1 would risk a recompile-overflow-regrow cycle
             # on a later, fuller batch
-            packed, out_meta, caps, r = executor.run_with_retry(
-                plan, feeds, caps, fingerprint, compute_dtype,
-                allow_tighten=False)
-            retries_total += r
-            cols, nulls, valid = unpack_outputs(packed, out_meta)
-            rows_scanned += int(np.asarray(valid).size)
-            parts.append(_flatten_batch(cols, nulls, valid))
+            from ..stats.tracing import trace_span
+
+            with trace_span("stream.batch", batch=n_consumed - 1):
+                packed, out_meta, caps, r = executor.run_with_retry(
+                    plan, feeds, caps, fingerprint, compute_dtype,
+                    allow_tighten=False)
+                retries_total += r
+                cols, nulls, valid = unpack_outputs(packed, out_meta)
+                rows_scanned += int(np.asarray(valid).size)
+                parts.append(_flatten_batch(cols, nulls, valid))
     finally:
         stop_evt.set()
         while True:  # drain so a blocked put wakes immediately

@@ -106,6 +106,19 @@ def dd_bucket(v, xp=np):
                     sign * k.astype(xp.int32)).astype(xp.int32)
 
 
+def dd_bucket_scalar(v: float) -> int:
+    """dd_bucket for ONE host float, pure math module — the numpy
+    formulation costs ~16 µs/call on scalars (ufunc dispatch), which
+    is most of the tracing recorder's per-statement budget; this is
+    ~0.2 µs with identical bucket keys."""
+    av = abs(v)
+    if av <= DD_EPS:
+        return 0
+    k = math.ceil(math.log(av) / DD_LOG_GAMMA)
+    k = min(max(k, DD_KMIN), DD_KMAX) - (DD_KMIN - 1)
+    return -k if v < 0 else k
+
+
 def dd_value(key: int) -> float:
     """Representative (log-midpoint) value of a bucket key."""
     if key == 0:

@@ -50,6 +50,8 @@ EXPLAIN_TAGS: dict[str, str] = {
     "Device Rows Scanned": "result-transfer volume in row slots",
     "Mesh": "device count, per-device rows in/out, all_to_all bytes "
             "for this statement",
+    "Timing": "per-phase wall-clock breakdown from this statement's "
+              "span trace (stats/tracing.py)",
     "Memory": "device-memory ledger + OOM degradation for this statement",
     "Resilience": "retry/failover totals for this statement",
     "Integrity": "stripes CRC-verified / read-repaired this statement",

@@ -134,6 +134,8 @@ class MicroBatcher:
         answer); raises the batch failure as a clean error."""
         from ..utils.cancellation import check_cancel
 
+        from ..stats.tracing import trace_span
+
         req = _Lookup(store, table, shard_id, column, value, columns)
         with self._mu:
             self.requests_total += 1
@@ -145,33 +147,35 @@ class MicroBatcher:
         if lead:
             led = self._lead(max(1, max_batch), max(0.0, window_s))
         else:
-            while not req.evt.wait(0.005):
-                try:
-                    check_cancel()  # deadline / Session.cancel() seam
-                except BaseException:
-                    # leaving the wait: resolve our queue slot so the
-                    # ledger never holds an abandoned request
+            with trace_span("serving.batch_wait"):
+                while not req.evt.wait(0.005):
+                    try:
+                        check_cancel()  # deadline / cancel() seam
+                    except BaseException:
+                        # leaving the wait: resolve our queue slot so
+                        # the ledger never holds an abandoned request
+                        with self._mu:
+                            if not req.evt.is_set():
+                                try:
+                                    self._queue.remove(req)
+                                except ValueError:
+                                    pass  # already in a running batch
+                                else:
+                                    self.errored_total += 1
+                                    req.evt.set()
+                        raise
+                    promote = False
                     with self._mu:
-                        if not req.evt.is_set():
-                            try:
-                                self._queue.remove(req)
-                            except ValueError:
-                                pass  # already in an executing batch
-                            else:
-                                self.errored_total += 1
-                                req.evt.set()
-                    raise
-                promote = False
-                with self._mu:
-                    if not self._leader_active and not req.evt.is_set():
-                        # the leader died or was cancelled with work
-                        # still queued: self-promote so no lookup ever
-                        # waits on dead air
-                        self._leader_active = True
-                        promote = True
-                if promote:
-                    led += self._lead(max(1, max_batch),
-                                      max(0.0, window_s))
+                        if not self._leader_active and \
+                                not req.evt.is_set():
+                            # the leader died or was cancelled with
+                            # work still queued: self-promote so no
+                            # lookup ever waits on dead air
+                            self._leader_active = True
+                            promote = True
+                    if promote:
+                        led += self._lead(max(1, max_batch),
+                                          max(0.0, window_s))
         if req.error is not None:
             raise req.error
         req.result.dispatches_led = led
@@ -213,7 +217,10 @@ class MicroBatcher:
                 if first and len(batch) > 1 and window_s > 0:
                     # arrivals already queued: hold the window once so
                     # the coalescing batch catches the burst's tail
-                    time.sleep(window_s)
+                    from ..stats.tracing import trace_span
+
+                    with trace_span("serving.door_hold"):
+                        time.sleep(window_s)
                     with self._mu:
                         while self._queue and len(batch) < max_batch:
                             batch.append(self._queue.popleft())
@@ -238,13 +245,22 @@ class MicroBatcher:
         (answered / errored / fallback) before returning; only
         BaseException (crash-sim power cuts, interpreter teardown)
         propagates — after delivering clean errors to the batch."""
-        from ..errors import QueryCanceled
-        from ..utils.faultinjection import fault_point
+        from ..stats.tracing import trace_span
 
         with self._mu:
             self.dispatch_total += 1
             self.batched_lookups_total += len(batch)
             self.max_batch_seen = max(self.max_batch_seen, len(batch))
+        # the probe span lives on the LEADER's statement trace: the
+        # flight recorder attributes coalesced work to the thread that
+        # actually did it (followers record serving.batch_wait)
+        with trace_span("serving.batch_probe", batched=len(batch)):
+            self._execute_batch_inner(batch)
+
+    def _execute_batch_inner(self, batch: list[_Lookup]) -> None:
+        from ..errors import QueryCanceled
+        from ..utils.faultinjection import fault_point
+
         try:
             # named seam: a fault at dispatch must error the WHOLE batch
             # cleanly — the ledger proves no request is ever lost here

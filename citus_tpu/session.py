@@ -47,6 +47,7 @@ _UDFS = ("create_distributed_table", "create_reference_table",
          "citus_move_shard_placement", "citus_get_node_clock",
          "citus_stat_counters", "citus_stat_counters_reset",
          "citus_stat_statements", "citus_stat_statements_reset",
+         "citus_stat_latency", "citus_stat_latency_reset",
          "citus_stat_tenants", "citus_stat_activity", "citus_stat_wlm",
          "citus_stat_serving", "citus_stat_memory", "citus_stat_mesh",
          "citus_rebalance_mesh", "citus_drain_device",
@@ -170,7 +171,7 @@ class Session:
         from .executor.runner import Executor
         from .stats import SessionStats
 
-        self.stats = SessionStats()
+        self.stats = SessionStats(self.data_dir, self.settings)
         self.executor = Executor(self.catalog, self.store, self.settings,
                                  self.mesh, counters=self.stats.counters)
         # workload manager: sessions sharing a data_dir share ONE
@@ -224,48 +225,72 @@ class Session:
                 os.path.join(self.data_dir, "catalog.json"))
         self._cancel_evt.clear()  # a fresh script clears stale cancels
         from .stats import counters as sc
+        from .stats.tracing import trace_span
         from .storage import integrity as _integrity
 
-        stmts = self._hot_stmts.get(sql)
-        if stmts is None:
-            stmts = tuple(parse(sql))
-            if len(self._hot_stmts) >= 512:
-                self._hot_stmts.clear()
-            self._hot_stmts[sql] = stmts
-        with self.stats.activity.track(sql) as activity:
-            t0 = _time.perf_counter()
-            for stmt in stmts:
-                activity.retries = 0
-                activity.read_repairs = 0
-                # per-STATEMENT snapshot (like the retries reset): the
-                # citus_stat_activity cache columns show the in-flight
-                # statement's own traffic, not the whole script's
-                activity.cache_base = (self.executor.plan_cache.hits,
-                                       self.executor.plan_cache.misses,
-                                       self.executor.feed_cache.hits,
-                                       self.executor.feed_cache.misses)
-                ibase = _integrity.snapshot()
-                try:
-                    result = self._execute_admitted(stmt, activity)
-                finally:
-                    # fold this statement's storage-integrity traffic
-                    # (module-wide accounting, like faults_injected)
-                    # into the session counters + the activity row
-                    idelta = _integrity.delta(ibase)
-                    c = self.stats.counters
-                    if idelta["stripes_verified"]:
-                        c.increment(sc.STRIPES_VERIFIED_TOTAL,
-                                    idelta["stripes_verified"])
-                    if idelta["corruption_detected"]:
-                        c.increment(sc.CORRUPTION_DETECTED_TOTAL,
-                                    idelta["corruption_detected"])
-                    if idelta["read_repairs"]:
-                        c.increment(sc.READ_REPAIRS_TOTAL,
-                                    idelta["read_repairs"])
-                        activity.read_repairs += idelta["read_repairs"]
-                self._count_statement(stmt, result)
-                tenant_hits.extend(extract_tenants(stmt, self.catalog))
-            elapsed_ms = (_time.perf_counter() - t0) * 1000.0
+        # span flight recorder: each statement of the script gets its
+        # own trace; the first one's covers parse (hot-statement memo
+        # hits make repeats ~free), so top-level spans tile the wall
+        tracer = self.stats.tracing
+        th = tracer.begin(sql)
+        trace_err = None
+        try:
+            stmts = self._hot_stmts.get(sql)
+            if stmts is None:
+                with trace_span("parse"):
+                    stmts = tuple(parse(sql))
+                if len(self._hot_stmts) >= 512:
+                    self._hot_stmts.clear()
+                self._hot_stmts[sql] = stmts
+            with self.stats.activity.track(sql) as activity:
+                t0 = _time.perf_counter()
+                first_stmt = True
+                for stmt in stmts:
+                    if not first_stmt:
+                        tracer.end(th)
+                        th = tracer.begin(sql)
+                    first_stmt = False
+                    activity.retries = 0
+                    activity.read_repairs = 0
+                    # per-STATEMENT snapshot (like the retries reset):
+                    # the citus_stat_activity cache columns show the
+                    # in-flight statement's own traffic, not the whole
+                    # script's
+                    activity.cache_base = (
+                        self.executor.plan_cache.hits,
+                        self.executor.plan_cache.misses,
+                        self.executor.feed_cache.hits,
+                        self.executor.feed_cache.misses)
+                    ibase = _integrity.snapshot()
+                    try:
+                        result = self._execute_admitted(stmt, activity)
+                    finally:
+                        # fold this statement's storage-integrity
+                        # traffic (module-wide accounting, like
+                        # faults_injected) into the session counters +
+                        # the activity row
+                        idelta = _integrity.delta(ibase)
+                        c = self.stats.counters
+                        if idelta["stripes_verified"]:
+                            c.increment(sc.STRIPES_VERIFIED_TOTAL,
+                                        idelta["stripes_verified"])
+                        if idelta["corruption_detected"]:
+                            c.increment(sc.CORRUPTION_DETECTED_TOTAL,
+                                        idelta["corruption_detected"])
+                        if idelta["read_repairs"]:
+                            c.increment(sc.READ_REPAIRS_TOTAL,
+                                        idelta["read_repairs"])
+                            activity.read_repairs += \
+                                idelta["read_repairs"]
+                    self._count_statement(stmt, result)
+                    tenant_hits.extend(extract_tenants(stmt,
+                                                       self.catalog))
+                elapsed_ms = (_time.perf_counter() - t0) * 1000.0
+        except BaseException as e:
+            trace_err = e
+            raise
+        finally:
+            tracer.end(th, error=trace_err)
         rows = getattr(result, "row_count", 0) if result is not None else 0
         self.stats.queries.record(sql, elapsed_ms, rows)
         for table, tenant in tenant_hits:
@@ -346,42 +371,63 @@ class Session:
         # mid-transaction while holding 2PL locks would create
         # slot↔lock deadlock cycles the lock-manager's detector cannot
         # see (it only walks lock waits — a slot edge is invisible)
-        if self.txn_manager.current is not None or \
-                not self.settings.get("wlm_enabled") or \
-                statement_exempt(target, self.catalog, self.settings,
-                                 _UDFS):
+        from .stats.tracing import trace_span
+
+        # exemption classification is admission work: its (small, but
+        # catalog/store-touching) cost books under the queue phase so
+        # top-level spans tile the statement wall (no meta: this span
+        # is on the serving hot path, and the kwargs dict costs QPS —
+        # the WAIT span below is the one carrying queued_ms)
+        with trace_span("queue"):
+            exempt = (self.txn_manager.current is not None
+                      or not self.settings.get("wlm_enabled")
+                      or statement_exempt(target, self.catalog,
+                                          self.settings, _UDFS))
+        if exempt:
             return self._execute_resilient(stmt, activity)
-        tenant = statement_tenant(target, self.catalog, self.settings)
-        weights = parse_tenant_weights(
-            self.settings.get("wlm_tenant_weights"))
-        req = AdmissionRequest(
-            tenant=tenant,
-            priority=self.settings.get("wlm_default_priority"),
-            feed_bytes=planned_feed_bytes(target, self.catalog,
-                                          self.store, self.n_devices,
-                                          self.settings),
-            weight=weights.get(tenant, 1),
-            max_slots=self.settings.get("max_concurrent_statements"),
-            max_feed_bytes=self.settings.get("max_feed_bytes_per_device"),
-            queue_depth=self.settings.get("wlm_queue_depth"))
-        timeout_ms = self.settings.get("statement_timeout_ms")
-        if activity is not None:
-            activity.wait_state = "queued"
-        try:
-            # the queue wait carries the same deadline/cancel machinery
-            # as execution (check_cancel fires every wait slice)
-            with deadline_scope(timeout_ms or None, self._cancel_evt):
-                ticket = self.wlm.admit(req)
-        except Exception as e:
+
+        # the "queue" span covers classification + the slot/HBM queue
+        # wait (its duration reconciles against ticket.queued_ms —
+        # tests pin the two within tolerance)
+        with trace_span("queue") as qspan:
+            tenant = statement_tenant(target, self.catalog,
+                                      self.settings)
+            weights = parse_tenant_weights(
+                self.settings.get("wlm_tenant_weights"))
+            req = AdmissionRequest(
+                tenant=tenant,
+                priority=self.settings.get("wlm_default_priority"),
+                feed_bytes=planned_feed_bytes(target, self.catalog,
+                                              self.store, self.n_devices,
+                                              self.settings),
+                weight=weights.get(tenant, 1),
+                max_slots=self.settings.get("max_concurrent_statements"),
+                max_feed_bytes=self.settings.get(
+                    "max_feed_bytes_per_device"),
+                queue_depth=self.settings.get("wlm_queue_depth"))
+            timeout_ms = self.settings.get("statement_timeout_ms")
             if activity is not None:
-                activity.wait_state = "running"
-            if isinstance(e, AdmissionRejected):
-                self.stats.counters.increment(sc.WLM_SHED_TOTAL)
-            elif isinstance(e, StatementTimeout):
-                self.stats.counters.increment(sc.TIMEOUTS_TOTAL)
-            elif isinstance(e, QueryCanceled):
-                self.stats.counters.increment(sc.QUERIES_CANCELED)
-            raise
+                activity.wait_state = "queued"
+            try:
+                # the queue wait carries the same deadline/cancel
+                # machinery as execution (check_cancel fires every
+                # wait slice)
+                with deadline_scope(timeout_ms or None,
+                                    self._cancel_evt):
+                    ticket = self.wlm.admit(req)
+            except Exception as e:
+                if activity is not None:
+                    activity.wait_state = "running"
+                if isinstance(e, AdmissionRejected):
+                    self.stats.counters.increment(sc.WLM_SHED_TOTAL)
+                elif isinstance(e, StatementTimeout):
+                    self.stats.counters.increment(sc.TIMEOUTS_TOTAL)
+                elif isinstance(e, QueryCanceled):
+                    self.stats.counters.increment(sc.QUERIES_CANCELED)
+                raise
+            if qspan is not None:
+                qspan.meta = {"tenant": ticket.tenant,
+                              "queued_ms": round(ticket.queued_ms, 3)}
         if activity is not None:
             activity.wait_state = "admitted"
             activity.queued_ms = ticket.queued_ms
@@ -450,6 +496,7 @@ class Session:
             StatementTimeout,
         )
         from .stats import counters as sc
+        from .stats.tracing import trace_span
         from .utils.cancellation import check_cancel, deadline_scope
 
         max_retries = self.settings.get("max_statement_retries")
@@ -473,7 +520,14 @@ class Session:
                     commit_txid = self.txn_manager.current.txid
                 try:
                     check_cancel()
-                    result = self._execute_statement(stmt)
+                    n_attempt = attempt + oom_steps + mesh_steps
+                    # first attempts (the steady state) skip the meta
+                    # kwargs dict — serving-QPS hot path
+                    espan = (trace_span("execute") if n_attempt == 0
+                             else trace_span("execute",
+                                             attempt=n_attempt))
+                    with espan:
+                        result = self._execute_statement(stmt)
                     if rescued:
                         # the statement ANSWERED because the mesh-
                         # degrade path rescued it — the device_loss
@@ -534,7 +588,8 @@ class Session:
                                 f"after {mesh_steps - 1} mesh "
                                 f"degrade(s): {e}",
                                 device_id=did, seam=e.seam) from e
-                        status = self._degrade_mesh(e)
+                        with trace_span("mesh.degrade"):
+                            status = self._degrade_mesh(e)
                         if status == "unsurvivable":
                             raise MeshDegradedError(
                                 f"no surviving mesh device to fail "
@@ -579,8 +634,9 @@ class Session:
                         if not self.settings.get("oom_degradation"):
                             raise
                         oom_steps += 1
-                        rung = self.executor.degrade_for_oom(
-                            oom_steps, getattr(e, "nbytes", None))
+                        with trace_span("oom.degrade", rung=oom_steps):
+                            rung = self.executor.degrade_for_oom(
+                                oom_steps, getattr(e, "nbytes", None))
                         if rung is None:
                             raise ResourceExhausted(
                                 "statement does not fit device memory "
@@ -636,7 +692,8 @@ class Session:
                     if delay:
                         # waiting on the cancel event (not time.sleep)
                         # keeps Session.cancel() prompt even mid-backoff
-                        self._cancel_evt.wait(delay)
+                        with trace_span("retry.backoff"):
+                            self._cancel_evt.wait(delay)
                     # loop: the next check_cancel raises if the sleep
                     # consumed the deadline or a cancel arrived
 
@@ -1105,6 +1162,18 @@ class Session:
                  "rows": [s.rows for s in entries]}, len(entries))
         elif e.name == "citus_stat_statements_reset":
             self.stats.queries.reset()
+        elif e.name == "citus_stat_latency":
+            # per-statement-class latency histograms from the span
+            # flight recorder: DDSketch buckets (α ≈ 1% relative
+            # error), so the quantiles are honest without raw samples
+            lrows = self.stats.tracing.latency_rows()
+            lcols = ["statement_class", "calls", "mean_ms", "p50_ms",
+                     "p95_ms", "p99_ms", "max_ms"]
+            return ResultSet(
+                lcols, {c: [r[c] for r in lrows] for c in lcols},
+                len(lrows))
+        elif e.name == "citus_stat_latency_reset":
+            self.stats.tracing.reset_latency()
         elif e.name == "citus_stat_tenants":
             entries = self.stats.tenants.entries()
             return ResultSet(
@@ -1688,32 +1757,37 @@ class Session:
         cache = self._serving_cache()
         if cache is not None:
             from .serving.result_cache import cache_key
+            from .stats.tracing import trace_span
 
-            keyed = cache_key(sel, params, self.catalog, self.settings,
-                              _UDFS)
-            if keyed is not None:
-                key, tables = keyed
-                hit, d_inv = cache.lookup(
-                    key, self.store.manifest_stat_sig)
-                if d_inv:  # this statement's poll did the dropping
+            with trace_span("serving.cache_lookup"):
+                keyed = cache_key(sel, params, self.catalog,
+                                  self.settings, _UDFS)
+                if keyed is not None:
+                    key, tables = keyed
+                    hit, d_inv = cache.lookup(
+                        key, self.store.manifest_stat_sig)
+                    if d_inv:  # this statement's poll did the dropping
+                        self.stats.counters.increment(
+                            sc.SERVING_CACHE_INVALIDATIONS_TOTAL, d_inv)
+                    if hit is not None:
+                        self.stats.counters.increment(
+                            sc.SERVING_CACHE_HITS_TOTAL)
+                        # fresh metadata, shared (immutable) column
+                        # arrays: a cached answer did no device work
+                        # of its own
+                        return dc_replace(hit, retries=0,
+                                          device_rows_scanned=0,
+                                          streamed_batches=0)
                     self.stats.counters.increment(
-                        sc.SERVING_CACHE_INVALIDATIONS_TOTAL, d_inv)
-                if hit is not None:
-                    self.stats.counters.increment(
-                        sc.SERVING_CACHE_HITS_TOTAL)
-                    # fresh metadata, shared (immutable) column arrays:
-                    # a cached answer did no device work of its own
-                    return dc_replace(hit, retries=0,
-                                      device_rows_scanned=0,
-                                      streamed_batches=0)
-                self.stats.counters.increment(sc.SERVING_CACHE_MISSES_TOTAL)
-                # freshness tokens captured BEFORE execution: a write
-                # landing mid-execution invalidates this fill (epoch)
-                # or the entry itself (manifest identity re-check)
-                fill = (key, tables,
-                        {t: self.store.manifest_stat_sig(t)
-                         for t in tables},
-                        cache.fill_token())
+                        sc.SERVING_CACHE_MISSES_TOTAL)
+                    # freshness tokens captured BEFORE execution: a
+                    # write landing mid-execution invalidates this
+                    # fill (epoch) or the entry itself (manifest
+                    # identity re-check)
+                    fill = (key, tables,
+                            {t: self.store.manifest_stat_sig(t)
+                             for t in tables},
+                            cache.fill_token())
         plan, cleanup = self._plan_select(sel, params)
         self._count_plan_shape(plan)
         try:
@@ -1779,21 +1853,24 @@ class Session:
 
     def _plan_select(self, sel: ast.Select,
                      params: tuple = ()) -> tuple[QueryPlan, list[str]]:
+        from .stats.tracing import trace_span
+
         cleanup: list[str] = []
-        prev = getattr(self._params_tls, "value", ())
-        self._params_tls.value = params
-        try:
-            sel = self._recursive_plan(sel, cleanup)
-        finally:
-            self._params_tls.value = prev
-        binder = Binder(self.catalog, _StoreDicts(self.store),
-                        params=params)
-        bound = binder.bind_select(sel)
-        planner = DistributedPlanner(
-            self.catalog, _StoreStats(self.store), self.n_devices,
-            self.settings.get("enable_repartition_joins"),
-            dicts=_StoreDicts(self.store))
-        plan = planner.plan(bound)
+        with trace_span("plan"):
+            prev = getattr(self._params_tls, "value", ())
+            self._params_tls.value = params
+            try:
+                sel = self._recursive_plan(sel, cleanup)
+            finally:
+                self._params_tls.value = prev
+            binder = Binder(self.catalog, _StoreDicts(self.store),
+                            params=params)
+            bound = binder.bind_select(sel)
+            planner = DistributedPlanner(
+                self.catalog, _StoreStats(self.store), self.n_devices,
+                self.settings.get("enable_repartition_joins"),
+                dicts=_StoreDicts(self.store))
+            plan = planner.plan(bound)
         if self.settings.get("log_distributed_plans"):
             import sys
 
@@ -1838,6 +1915,28 @@ class Session:
                 result = self.executor.execute_plan(plan)
                 elapsed = time.perf_counter() - t0
                 lines.append(f"Execution Time: {elapsed * 1000:.2f} ms")
+                # per-phase wall-clock attribution from this
+                # statement's own span trace (the EXPLAIN ANALYZE
+                # statement is the traced unit; its plan/feed/compile/
+                # dispatch spans are already closed at this point)
+                from .stats.tracing import (
+                    current_root,
+                    format_timing_line,
+                )
+
+                troot = current_root()
+                if troot is not None:
+                    lines.append(f"{explain_tag('Timing')}: "
+                                 + format_timing_line(troot))
+                else:
+                    # no trace for THIS statement: trace_enabled off,
+                    # or the sampling knobs skipped its tree — saying
+                    # just "off" would mislead an operator of a live
+                    # (sampled) system
+                    lines.append(
+                        f"{explain_tag('Timing')}: "
+                        f"total={elapsed * 1000:.2f}ms "
+                        "(no trace: tracing off or sampled out)")
                 lines.append(f"Rows: {result.row_count}"
                              + (f" (capacity retries: {result.retries})"
                                 if result.retries else ""))

@@ -6,21 +6,28 @@ from .counters import ALL_COUNTERS, StatCounters
 from .progress import ProgressMonitor, ProgressRegistry
 from .query_stats import QueryStats, fingerprint
 from .tenants import TenantStats, extract_tenants
+from .tracing import TraceRecorder
 
 
 class SessionStats:
-    """Bundle owned by each Session (the shared-memory segment analogue)."""
+    """Bundle owned by each Session (the shared-memory segment analogue).
 
-    def __init__(self):
+    `data_dir`/`settings` feed the trace recorder (slow-query log
+    destination + the trace_* knobs); both default to None for
+    unit-test construction (tracing then runs in-memory with
+    defaults)."""
+
+    def __init__(self, data_dir: str | None = None, settings=None):
         self.counters = StatCounters()
         self.queries = QueryStats()
         self.tenants = TenantStats()
         self.progress = ProgressRegistry()
         self.activity = ActivityRegistry()
+        self.tracing = TraceRecorder(data_dir, settings)
 
 
 __all__ = [
     "ALL_COUNTERS", "ActivityRegistry", "ProgressMonitor",
     "ProgressRegistry", "QueryStats", "SessionStats", "StatCounters",
-    "TenantStats", "extract_tenants", "fingerprint",
+    "TenantStats", "TraceRecorder", "extract_tenants", "fingerprint",
 ]

@@ -112,6 +112,7 @@ FAULT_POINTS: dict[str, str] = {
 _lock = threading.Lock()
 _armed: dict[str, dict] = {}
 _injected_total = 0  # module-wide trigger count (all sessions)
+_fired: dict[str, int] = {}  # per-point trigger counts since reset()
 
 
 def registered_points() -> dict[str, str]:
@@ -120,6 +121,16 @@ def registered_points() -> dict[str, str]:
 
 def injected_total() -> int:
     return _injected_total
+
+
+def fired_count(name: str) -> int:
+    """How many times the armed point `name` actually triggered since
+    reset() — the reachability oracle for directed fault tests (an
+    armed point that never fires tested nothing; the classic mask is
+    the serving result cache answering a repeated statement without
+    executing)."""
+    with _lock:
+        return _fired.get(name, 0)
 
 
 def fault_point(name: str) -> None:
@@ -147,6 +158,7 @@ def fault_point(name: str) -> None:
         kind = spec["error"]
         global _injected_total
         _injected_total += 1
+        _fired[name] = _fired.get(name, 0) + 1
     if sleep:
         time.sleep(sleep)  # delay fault (outside the lock)
     if kind is None:
@@ -206,14 +218,35 @@ def disarm(name: str) -> None:
 @contextlib.contextmanager
 def inject(name: str, after: int = 0, once: bool = True,
            times: int | None = None, p: float = 1.0, sleep: float = 0.0,
-           error: str | None = "injected", seed: int | None = None):
-    """Arm `name` for the duration of the block (see `arm`)."""
+           error: str | None = "injected", seed: int | None = None,
+           require_fired: bool = False):
+    """Arm `name` for the duration of the block (see `arm`).
+
+    ``require_fired=True`` asserts on clean exit that the armed point
+    actually triggered at least once inside the block — a directed
+    test whose fault is reachable must say so, and then a result-cache
+    hit / pruned path / renamed seam silently absorbing the statement
+    becomes a test FAILURE instead of a green no-op.  (The assert is
+    skipped when the block is already unwinding an exception, so it
+    never masks the real failure.)"""
+    base = fired_count(name)
     arm(name, after=after, once=once, times=times, p=p, sleep=sleep,
         error=error, seed=seed)
     try:
         yield
-    finally:
+    except BaseException:
         disarm(name)
+        raise
+    else:
+        disarm(name)
+        if require_fired and fired_count(name) - base < 1:
+            raise AssertionError(
+                f"armed fault point {name!r} never fired inside the "
+                "inject() block — the statement it targets was "
+                "answered without reaching the seam (result cache? "
+                "pruned path?); pass serving_result_cache_bytes=0 or "
+                "vary the statement so the fault is actually "
+                "exercised")
 
 
 class MeshSim:
@@ -320,6 +353,7 @@ def reset() -> None:
     global _mesh_sim
     with _lock:
         _armed.clear()
+        _fired.clear()
         _mesh_sim = None
 
 

@@ -1,0 +1,16 @@
+"""Fixture registry: span names (one recorded, one dead)."""
+
+SPAN_NAMES = {
+    "live.span": "recorded by uses.py",
+    "dead.span": "never recorded",        # span-registry
+}
+
+
+def trace_span(name, **meta):
+    SPAN_NAMES[name]
+    return name
+
+
+def span_name(name):
+    SPAN_NAMES[name]
+    return name

@@ -2,6 +2,7 @@
 
 from .planner.explain import explain_tag
 from .stats import counters as sc
+from .stats.tracing import trace_span
 from .utils.faultinjection import FAULT_POINTS  # noqa: F401
 
 
@@ -25,4 +26,6 @@ def run(settings):
     settings.get("live_knob")            # registered: clean
     settings.get("ghost_knob")           # config-registry (unregistered)
     explain_tag("Live Tag")              # registered: clean
-    return explain_tag("Ghost Tag")      # explain-tag-registry
+    explain_tag("Ghost Tag")             # explain-tag-registry
+    trace_span("live.span")              # registered: clean
+    return trace_span("ghost.span")      # span-registry

@@ -364,3 +364,31 @@ def test_readme_pipelined_scan_claims_match_artifact(artifact):
             f"README wire ratio must quote "
             f"{line['wire_ratio'] * 100:g}% from "
             f"{os.path.basename(artifact)}")
+
+
+def test_readme_phase_attribution_requires_trace_derived_keys(artifact):
+    """PR-14 honesty gate: phase-attribution numbers (transfer wall
+    share, phase_* walls) may be quoted in the README only when the
+    newest artifact derived them FROM THE SPAN TRACE (the driver
+    stamps `phase_source: "trace"`) — hand-rolled timers and EXPLAIN
+    must agree by construction, so a quote not backed by the trace is
+    a quote the flight recorder cannot corroborate."""
+    with open(os.path.join(ROOT, "README.md")) as f:
+        text = f.read()
+    q_share = re.search(
+        r"transfer wall share (\d+(?:\.\d+)?)% \(driver", text)
+    metrics = _artifact_metrics(artifact)
+    line = metrics.get("columnar_scan_gb_per_sec") or {}
+    trace_derived = line.get("phase_source") == "trace"
+    if q_share is not None:
+        assert trace_derived, (
+            "README quotes a phase-attribution number (transfer wall "
+            f"share) but {os.path.basename(artifact)}'s scan line is "
+            f"not trace-derived (phase_source="
+            f"{line.get('phase_source')!r}); re-run bench.py so the "
+            "phase keys come from the span flight recorder")
+    # and a trace-derived artifact must carry coherent phase keys
+    if trace_derived:
+        for key in ("phase_prefetch_decode_seconds",
+                    "phase_transfer_dispatch_seconds"):
+            assert key in line, f"phase_source=trace without {key}"

@@ -288,6 +288,17 @@ def _run_soak_inner(tmp_path, n_ops: int, seed: int, fault_rate: float):
     if acc.live_bytes("prefetch"):
         gc.collect()  # traceback-pinned payloads release at collection
     assert acc.live_bytes("prefetch") == 0, acc.snapshot()
+    # the span flight recorder leaked nothing: no statement — however
+    # it died (armed fault, timeout, OOM rung, device loss) — left an
+    # open span on ANY thread, and no producer-thread adoption leaked
+    # into a finished trace (the prefetch-charge zero-leak assert,
+    # applied to the tracing dimension)
+    from citus_tpu.stats.tracing import open_span_count
+
+    assert open_span_count() == 0
+    for sess in sessions:
+        assert all(t.leaked == 0 for t in sess.stats.tracing.traces()), \
+            "a chaos statement leaked spans inside its trace"
     for sess in sessions:
         sess.close()
     fresh.close()

@@ -492,7 +492,10 @@ class TestResilientExecution:
         setup_accounts(sess)
         from citus_tpu.stats import counters as sc
 
-        with inject("store.read_shard"):
+        # require_fired: the retry layer ABSORBS this fault, so a green
+        # run must prove the armed seam was actually reached (a result-
+        # cache hit or pruned path would otherwise test nothing)
+        with inject("store.read_shard", require_fired=True):
             assert totals(sess) == (8, 3600)
         snap = sess.stats.counters.snapshot()
         assert snap[sc.RETRIES_TOTAL] >= 1
@@ -510,7 +513,8 @@ class TestResilientExecution:
         assert len(sess.catalog.shard_placements(shard.shard_id)) == 2
         before = {s.shard_id: sess.catalog.active_placement(s.shard_id)
                   .placement_id for s in sess.catalog.table_shards("acc")}
-        with inject("store.read_shard", error="storage"):
+        with inject("store.read_shard", error="storage",
+                    require_fired=True):
             assert totals(sess) == (8, 3600)
         snap = sess.stats.counters.snapshot()
         assert snap[sc.FAILOVERS_TOTAL] >= 1
@@ -600,10 +604,13 @@ class TestResilientExecution:
         setup_accounts(sess)
         sess.execute("BEGIN")
         sess.execute("UPDATE acc SET bal = 0 WHERE id = 1")
-        with inject("txn.apply"):
+        with inject("txn.apply", require_fired=True):
             sess.execute("COMMIT")  # no raise
         assert totals(sess) == (8, 3600 - 200)
-        fresh = citus_tpu.connect(data_dir=tmp_data_dir)
+        # cache off: this session exists to verify the ON-DISK state
+        # (a shared-result-cache hit of sess's fill would prove nothing)
+        fresh = citus_tpu.connect(data_dir=tmp_data_dir,
+                                  serving_result_cache_bytes=0)
         assert totals(fresh) == (8, 3600 - 200)
 
     def test_recovery_under_retry_no_double_apply(self, tmp_data_dir):
@@ -620,14 +627,17 @@ class TestResilientExecution:
         sess.execute("BEGIN")
         sess.execute("UPDATE ta SET v = v + 5 WHERE id < 4")
         sess.execute("UPDATE tb SET v = v + 7 WHERE id < 4")
-        with inject("store.apply_dml", after=1):
+        with inject("store.apply_dml", after=1, require_fired=True):
             sess.execute("COMMIT")  # ta applied, tb dies; recovery replays
         r = sess.execute("SELECT sum(v) FROM ta").rows()[0][0]
         assert int(r) == 8 * 100 + 4 * 5
         r = sess.execute("SELECT sum(v) FROM tb").rows()[0][0]
         assert int(r) == 8 * 100 + 4 * 7
-        # and a fresh session agrees (nothing half-applied on disk)
-        fresh = citus_tpu.connect(data_dir=tmp_data_dir)
+        # and a fresh session agrees (nothing half-applied on DISK —
+        # cache off, or this would re-serve sess's result-cache fill
+        # for the identical statement and verify nothing)
+        fresh = citus_tpu.connect(data_dir=tmp_data_dir,
+                                  serving_result_cache_bytes=0)
         assert int(fresh.execute(
             "SELECT sum(v) FROM ta").rows()[0][0]) == 8 * 100 + 4 * 5
 
@@ -687,6 +697,59 @@ class TestResilientExecution:
             assert hits == 2
         finally:
             disarm("unit.times")
+
+
+class TestRequireFired:
+    """The reachability assert (PR-14 satellite): an armed, supposedly
+    reachable fault point that never fires must FAIL the directed test
+    instead of passing vacuously."""
+
+    def test_unreached_armed_point_fails_the_block(self, tmp_data_dir):
+        sess = citus_tpu.connect(data_dir=tmp_data_dir)
+        setup_accounts(sess)
+        with pytest.raises(AssertionError, match="never fired"):
+            # stream.prefetch is unreachable for this tiny resident
+            # read — require_fired turns the silent no-op into a fail
+            with inject("stream.prefetch", require_fired=True):
+                totals(sess)
+
+    def test_fired_point_passes(self, tmp_data_dir):
+        sess = citus_tpu.connect(data_dir=tmp_data_dir,
+                                 retry_backoff_base_ms=1)
+        setup_accounts(sess)
+        with inject("store.read_shard", require_fired=True):
+            assert totals(sess) == (8, 3600)
+
+    def test_result_cache_masking_is_caught(self, tmp_data_dir):
+        """THE mask this satellite exists for: a directed test that
+        repeats identical SQL with the serving result cache on never
+        re-executes — the armed read fault sits unreached while the
+        test goes green.  require_fired converts that into a visible
+        failure (the fix in real tests: serving_result_cache_bytes=0
+        or vary the statement)."""
+        sess = citus_tpu.connect(data_dir=tmp_data_dir,
+                                 retry_backoff_base_ms=1)
+        setup_accounts(sess)
+        assert totals(sess) == (8, 3600)  # fills the result cache
+        with pytest.raises(AssertionError, match="never fired"):
+            with inject("store.read_shard", require_fired=True):
+                # identical statement: served from the cache, the
+                # armed seam is never reached
+                assert totals(sess) == (8, 3600)
+        # the documented fix makes the same pattern honest again
+        fresh = citus_tpu.connect(data_dir=tmp_data_dir,
+                                  serving_result_cache_bytes=0,
+                                  retry_backoff_base_ms=1)
+        with inject("store.read_shard", require_fired=True):
+            assert totals(fresh) == (8, 3600)
+
+    def test_assert_never_masks_a_real_failure(self):
+        # a block already unwinding a real exception must propagate
+        # THAT, not an AssertionError about an unfired (unreachable)
+        # point — the reachability check only judges clean exits
+        with pytest.raises(ValueError, match="real failure"):
+            with inject("stream.prefetch", require_fired=True):
+                raise ValueError("real failure")
 
 
 class TestFaultPointRegistry:
@@ -825,7 +888,8 @@ class TestRetryClassificationEdges:
         # (after its own check_cancel), so the NEXT seam inside the
         # apply raises with the commit record already durable
         sess.execute("SET statement_timeout_ms = 400")
-        with inject("txn.apply", error=None, sleep=0.5):
+        with inject("txn.apply", error=None, sleep=0.5,
+                    require_fired=True):
             sess.execute("COMMIT")  # resolved as success, no raise
         sess.execute("SET statement_timeout_ms = 0")
         assert totals(sess) == (8, 3600 - 200)

@@ -355,7 +355,8 @@ class TestStorageFaultPoints:
         sess = self._sess(tmp_path, max_statement_retries=2)
         sess.execute("CREATE TABLE kv (id INT, v INT)")
         sess.execute("SELECT create_distributed_table('kv', 'id', 2)")
-        with fi.inject("storage.stripe_torn_write"):
+        with fi.inject("storage.stripe_torn_write",
+                       require_fired=True):
             sess.execute("INSERT INTO kv VALUES (1, 1)")  # retried
         assert int(sess.execute(
             "SELECT count(*) FROM kv").rows()[0][0]) == 1
@@ -381,7 +382,10 @@ class TestStorageFaultPoints:
         sess.execute("INSERT INTO kv VALUES " + ", ".join(
             f"({i}, {i})" for i in range(32)))
         sess.store.refresh("kv")
-        with fi.inject("storage.stripe_bitflip"):
+        # the bitflip is injected CORRUPTION (not an exception), so
+        # nothing raises — require_fired is the only proof the armed
+        # seam was reached and the CRC path actually got tested
+        with fi.inject("storage.stripe_bitflip", require_fired=True):
             got = {int(i) for i, in
                    sess.execute("SELECT id FROM kv").rows()}
         assert got == set(range(32))  # repaired or untouched, never wrong

@@ -107,15 +107,17 @@ GOLDEN = {
     ("traced-python-branch", "citus_tpu/executor/hot.py", 47),
     ("device-sync-in-loop", "citus_tpu/executor/stream.py", 10),
     ("device-sync-in-loop", "citus_tpu/executor/stream.py", 11),
-    ("fault-point-registry", "citus_tpu/uses.py", 22),
+    ("fault-point-registry", "citus_tpu/uses.py", 23),
     ("fault-point-registry", "citus_tpu/utils/faultinjection.py", 5),
-    ("counter-registry", "citus_tpu/uses.py", 24),
+    ("counter-registry", "citus_tpu/uses.py", 25),
     ("counter-registry", "citus_tpu/stats/counters.py", 1),
     ("counter-registry", "citus_tpu/stats/counters.py", 7),
-    ("config-registry", "citus_tpu/uses.py", 26),
+    ("config-registry", "citus_tpu/uses.py", 27),
     ("config-registry", "citus_tpu/config.py", 17),
-    ("explain-tag-registry", "citus_tpu/uses.py", 28),
+    ("explain-tag-registry", "citus_tpu/uses.py", 29),
     ("explain-tag-registry", "citus_tpu/planner/explain.py", 5),
+    ("span-registry", "citus_tpu/uses.py", 31),
+    ("span-registry", "citus_tpu/stats/tracing.py", 5),
 }
 
 
@@ -141,7 +143,8 @@ def test_each_rule_family_has_a_firing_fixture():
         "hotpath": {"host-sync-in-traced", "traced-python-branch",
                     "device-sync-in-loop", "jit-in-loop"},
         "registries": {"fault-point-registry", "counter-registry",
-                       "config-registry", "explain-tag-registry"},
+                       "config-registry", "explain-tag-registry",
+                       "span-registry"},
         "discipline": {"bare-except", "swallowed-base-exception",
                        "swallowed-fault-seam", "silent-exception",
                        "unowned-thread", "raw-durable-write",
@@ -193,6 +196,8 @@ def test_subset_scan_skips_unused_direction():
     assert run_lint(
         ROOT, subdirs=("citus_tpu/planner/explain.py",)) == []
     assert run_lint(ROOT, subdirs=("citus_tpu/config.py",)) == []
+    assert run_lint(
+        ROOT, subdirs=("citus_tpu/stats/tracing.py",)) == []
 
 
 def test_counter_registry_in_sync(tree_scan):
@@ -203,6 +208,11 @@ def test_counter_registry_in_sync(tree_scan):
 def test_explain_tag_registry_in_sync(tree_scan):
     assert [f for f in tree_scan[0]
             if f.rule == "explain-tag-registry"] == []
+
+
+def test_span_registry_in_sync(tree_scan):
+    assert [f for f in tree_scan[0]
+            if f.rule == "span-registry"] == []
 
 
 def test_config_registry_in_sync_modulo_baseline(tree_scan):

@@ -235,7 +235,8 @@ def test_oom_fault_injection_directed(sess, oracle):
     try:
         _reset_degradation(sess)
         snap0 = sess.stats.counters.snapshot()
-        with inject("executor.hbm_exhausted", error="oom"):
+        with inject("executor.hbm_exhausted", error="oom",
+                    require_fired=True):
             got = sess.execute(WORKLOAD[1]).rows()
         assert got == oracle[1]
         snap = sess.stats.counters.snapshot()

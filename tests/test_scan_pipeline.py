@@ -278,7 +278,7 @@ class TestPipelineFaults:
         _seed_kv(sess, n=500)
         want = sess.execute("SELECT count(*), sum(v) FROM kv").rows()
         sess.executor.feed_cache.clear()
-        with inject("executor.scan_prefetch"):
+        with inject("executor.scan_prefetch", require_fired=True):
             got = sess.execute("SELECT count(*), sum(v) FROM kv").rows()
         assert got == want
         assert _prefetch_bytes(d) == 0
@@ -309,7 +309,7 @@ class TestPipelineFaults:
         _seed_kv(sess, n=500)
         want = sess.execute("SELECT count(*), sum(v) FROM kv").rows()
         sess.executor.feed_cache.clear()
-        with inject("executor.device_decode"):
+        with inject("executor.device_decode", require_fired=True):
             got = sess.execute("SELECT count(*), sum(v) FROM kv").rows()
         assert got == want
         assert _prefetch_bytes(d) == 0
@@ -330,7 +330,8 @@ class TestPipelineFaults:
         from citus_tpu.stats import counters as scnt
 
         f0 = sess.stats.counters.snapshot()[scnt.FAILOVERS_TOTAL]
-        with inject("store.read_shard", error="storage"):
+        with inject("store.read_shard", error="storage",
+                    require_fired=True):
             got = sess.execute("SELECT count(*), sum(v) FROM kv").rows()
         assert got == want
         assert sess.stats.counters.snapshot()[

@@ -1,0 +1,456 @@
+"""Span flight recorder (stats/tracing.py): trace correctness.
+
+The contracts under test (ISSUE 14):
+* top-level spans TILE the statement wall (sum within tolerance) — the
+  reconciliation that makes queued_ms / retry waits / degradation-rung
+  time add up instead of living in three disconnected reports;
+* spans nest correctly across the scanpipe producer thread and the
+  serving leader/follower promotion, with ZERO open spans left behind;
+* the in-memory ring and per-trace span counts stay bounded under a
+  many-session hammer;
+* DDSketch latency histograms (citus_stat_latency) report honest
+  quantiles; sampling and trace_enabled degrade recording, never
+  correctness;
+* the slow-query log persists through the io seam and the Chrome
+  export's top-level spans sum to statement wall (the acceptance
+  shape, exercised here at test scale and by bench.py at SF10).
+"""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+import citus_tpu
+from citus_tpu.stats.tracing import (
+    open_span_count,
+    phase_breakdown,
+    span_seconds,
+)
+from citus_tpu.utils.faultinjection import inject, reset
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    reset()
+    yield
+    reset()
+
+
+def _mk(data_dir, **kw):
+    kw.setdefault("n_devices", 2)
+    kw.setdefault("retry_backoff_base_ms", 1)
+    kw.setdefault("retry_backoff_max_ms", 5)
+    # result cache off by default: most contracts here need the
+    # statement to actually execute, not be served from the cache
+    kw.setdefault("serving_result_cache_bytes", 0)
+    return citus_tpu.connect(data_dir=data_dir, **kw)
+
+
+def _seed(sess, n=4000):
+    sess.execute("CREATE TABLE kv (id INT, v INT, w FLOAT)")
+    sess.execute("SELECT create_distributed_table('kv', 'id', 4)")
+    vals = ", ".join(f"({i}, {i % 17}, {i * 0.25})" for i in range(n))
+    sess.execute(f"INSERT INTO kv VALUES {vals}")
+
+
+def _top_sum_ms(doc):
+    return sum(c["dur_ms"] for c in doc["root"].get("children", ()))
+
+
+def _assert_tiles_wall(doc, share=0.95, abs_ms=5.0):
+    wall = doc["root"]["dur_ms"]
+    top = _top_sum_ms(doc)
+    assert top <= wall * 1.001 + 0.05, (top, wall)
+    gap = wall - top
+    assert gap <= max((1.0 - share) * wall, abs_ms), (
+        f"top-level spans cover only {top:.2f} of {wall:.2f} ms "
+        f"(gap {gap:.2f} ms) — a phase is untraced:\n"
+        + json.dumps(doc["root"], indent=1)[:2000])
+
+
+# ---------------------------------------------------------------------------
+# sum-to-wall reconciliation (tier-1 satellite)
+# ---------------------------------------------------------------------------
+class TestSumToWall:
+    def test_cold_select_top_level_spans_tile_wall(self, tmp_path):
+        sess = _mk(str(tmp_path / "d"))
+        _seed(sess)
+        sess.execute("SELECT sum(v), sum(w) FROM kv WHERE v > 3")
+        sess.executor.feed_cache.clear()
+        sess.execute("SELECT sum(v), sum(w) FROM kv WHERE v > 3")
+        doc = sess.stats.tracing.last_trace()
+        assert doc is not None and doc["root"]["name"] == "statement"
+        _assert_tiles_wall(doc)
+        # wall_ms in the doc is the recorder's own statement clock
+        assert abs(doc["wall_ms"] - doc["root"]["dur_ms"]) < 1.0
+        assert open_span_count() == 0
+        sess.close()
+
+    def test_queue_span_reconciles_wlm_queued_ms(self, tmp_path):
+        """queued_ms (WLM stats), previously only reported beside the
+        trace, must equal the traced queue-wait within tolerance."""
+        d = str(tmp_path / "d")
+        sess = _mk(d, max_concurrent_statements=1)
+        _seed(sess, n=1500)
+        sql = "SELECT count(*), sum(v) FROM kv WHERE v >= 0"
+        sess.execute(sql)  # warm
+        other = _mk(d, max_concurrent_statements=1)
+        # occupy the single admission slot: the other session's cold
+        # read sleeps 0.2 s at the read seam while holding it
+        from citus_tpu.utils.faultinjection import arm, disarm
+
+        arm("store.read_shard", sleep=0.2, error=None, once=True)
+        try:
+            hog = threading.Thread(
+                target=lambda: other.execute(sql + " AND v < 99"))
+            hog.start()
+            time.sleep(0.05)  # let the hog admit + start executing
+            sess.execute(sql)
+            hog.join(30)
+        finally:
+            disarm("store.read_shard")
+        doc = sess.stats.tracing.last_trace()
+        waits = [c for c in doc["root"]["children"]
+                 if c["name"] == "queue"
+                 and (c.get("meta") or {}).get("queued_ms")
+                 is not None]
+        assert waits, doc["root"]
+        waited = max(waits, key=lambda c: c["meta"]["queued_ms"])
+        queued_ms = waited["meta"]["queued_ms"]
+        span_ms = waited["dur_ms"]
+        assert queued_ms > 20.0, "the statement never actually queued"
+        # the span covers classification + wait: >= queued_ms, and the
+        # non-wait part must be small
+        assert span_ms >= queued_ms - 1.0, (span_ms, queued_ms)
+        assert span_ms - queued_ms < 60.0, (span_ms, queued_ms)
+        _assert_tiles_wall(doc, abs_ms=8.0)
+        sess.close()
+        other.close()
+
+    def test_retry_and_backoff_time_visible_in_trace(self, tmp_path):
+        """Retry waits reconcile through the trace: a retried statement
+        shows N execute attempts + retry.backoff, still tiling wall."""
+        sess = _mk(str(tmp_path / "d"), retry_backoff_base_ms=20,
+                   retry_backoff_max_ms=40)
+        _seed(sess, n=800)
+        sess.executor.feed_cache.clear()
+        with inject("store.read_shard", require_fired=True):
+            sess.execute("SELECT count(*), sum(v) FROM kv")
+        doc = sess.stats.tracing.last_trace()
+        names = [c["name"] for c in doc["root"]["children"]]
+        assert names.count("execute") >= 2, names  # failed + retried
+        assert "retry.backoff" in names, names
+        backoff_s = span_seconds(doc["root"], "retry.backoff")
+        assert backoff_s * 1000 >= 5.0  # the backoff actually waited
+        _assert_tiles_wall(doc, abs_ms=8.0)
+        # the failed attempt's span records the error class
+        failed = [c for c in doc["root"]["children"]
+                  if c["name"] == "execute"
+                  and (c.get("meta") or {}).get("error")]
+        assert failed, doc["root"]
+        sess.close()
+
+    def test_oom_degradation_rung_time_visible_in_trace(self, tmp_path):
+        sess = _mk(str(tmp_path / "d"))
+        _seed(sess, n=800)
+        sess.executor.feed_cache.clear()
+        with inject("executor.hbm_exhausted", error="oom",
+                    require_fired=True):
+            sess.execute("SELECT count(*), sum(w) FROM kv")
+        doc = sess.stats.tracing.last_trace()
+        names = [c["name"] for c in doc["root"]["children"]]
+        assert "oom.degrade" in names, names
+        _assert_tiles_wall(doc, abs_ms=8.0)
+        sess.close()
+
+
+# ---------------------------------------------------------------------------
+# cross-thread nesting
+# ---------------------------------------------------------------------------
+class TestCrossThreadNesting:
+    def test_scanpipe_producer_spans_nest_under_feed(self, tmp_path):
+        sess = _mk(str(tmp_path / "d"), scan_pipeline="host")
+        _seed(sess)
+        sess.execute("SELECT sum(v), sum(w) FROM kv")
+        sess.executor.feed_cache.clear()
+        sess.execute("SELECT sum(v), sum(w) FROM kv")
+        doc = sess.stats.tracing.last_trace()
+
+        def find(span, name, out):
+            if span["name"] == name:
+                out.append(span)
+            for c in span.get("children", ()):
+                find(c, name, out)
+
+        feeds, prefetch = [], []
+        find(doc["root"], "feed", feeds)
+        find(doc["root"], "scan.prefetch", prefetch)
+        assert feeds and prefetch
+        # the producer's spans are CHILDREN of the feed span, recorded
+        # from a different thread
+        under_feed = []
+        for f in feeds:
+            find(f, "scan.prefetch", under_feed)
+        assert under_feed == prefetch
+        stmt_tid = doc["root"]["tid"]
+        assert any(p["tid"] != stmt_tid for p in prefetch), (
+            "producer spans should carry the producer thread's id")
+        assert span_seconds(doc["root"], "scan.prefetch") > 0
+        assert open_span_count() == 0
+        sess.close()
+
+    def test_device_mode_records_wire_and_decode_legs(self, tmp_path):
+        sess = _mk(str(tmp_path / "d"), scan_pipeline="device")
+        _seed(sess)
+        sess.execute("SELECT sum(v) FROM kv")
+        sess.executor.feed_cache.clear()
+        sess.execute("SELECT sum(v) FROM kv")
+        doc = sess.stats.tracing.last_trace()
+        for name in ("scan.prefetch", "scan.wire_encode",
+                     "scan.transfer", "scan.device_decode"):
+            assert span_seconds(doc["root"], name) > 0, name
+        # trace-derived legs match ScanPhaseStats within slack (both
+        # time the same regions; bench drivers now read the trace)
+        assert open_span_count() == 0
+        sess.close()
+
+    def test_serving_leader_follower_spans(self, tmp_path):
+        """Concurrent point lookups: the leader's trace carries the
+        batch probe, followers carry the wait — and every session's
+        stack is empty afterward (the leader/follower promotion path
+        cannot leak spans)."""
+        d = str(tmp_path / "d")
+        seed = _mk(d)
+        seed.execute("CREATE TABLE pt (id INT, v INT)")
+        seed.execute("SELECT create_distributed_table('pt', 'id', 2)")
+        seed.execute("INSERT INTO pt VALUES " + ", ".join(
+            f"({i}, {i * 10})" for i in range(64)))
+        sql = "SELECT v FROM pt WHERE id = 7"
+        seed.execute(sql)  # build the pkindex sidecars
+        sessions = [_mk(d, serving_batch_window_ms=5.0)
+                    for _ in range(4)]
+        for s in sessions:
+            s.execute(sql)  # warm plan/parse
+        barrier = threading.Barrier(len(sessions))
+
+        def worker(s):
+            barrier.wait()
+            for _ in range(5):
+                r = s.execute(sql)
+                assert r.row_count == 1
+        threads = [threading.Thread(target=worker, args=(s,))
+                   for s in sessions]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60)
+        probe = wait = 0.0
+        for s in sessions:
+            for tr in s.stats.tracing.traces():
+                d_ = tr.to_dict()
+                probe += span_seconds(d_["root"],
+                                      "serving.batch_probe")
+                wait += span_seconds(d_["root"], "serving.batch_wait")
+                assert tr.leaked == 0
+        assert probe > 0, "no leader ever recorded a batch probe"
+        assert open_span_count() == 0
+        for s in sessions:
+            s.close()
+        seed.close()
+
+
+# ---------------------------------------------------------------------------
+# boundedness / sampling / histograms
+# ---------------------------------------------------------------------------
+class TestBoundedness:
+    def test_ring_and_span_caps_bound_memory(self, tmp_path):
+        from citus_tpu.stats.tracing import MAX_SPANS_PER_TRACE
+
+        sess = _mk(str(tmp_path / "d"), trace_ring_statements=6)
+        _seed(sess, n=300)
+        for i in range(25):
+            sess.execute(f"SELECT count(*) FROM kv WHERE v = {i % 5}")
+        traces = sess.stats.tracing.traces()
+        assert len(traces) <= 6
+        assert all(t.spans <= MAX_SPANS_PER_TRACE for t in traces)
+        assert sess.stats.tracing.ring_bytes() < 6 * \
+            MAX_SPANS_PER_TRACE * 200 + 1
+        sess.close()
+
+    def test_eight_session_hammer_stays_bounded(self, tmp_path):
+        d = str(tmp_path / "d")
+        seed = _mk(d)
+        _seed(seed, n=500)
+        sessions = [_mk(d, trace_ring_statements=4) for _ in range(8)]
+        barrier = threading.Barrier(len(sessions))
+
+        def worker(wid, s):
+            barrier.wait()
+            for i in range(8):
+                s.execute(
+                    f"SELECT count(*) FROM kv WHERE v = {(wid + i) % 7}")
+        threads = [threading.Thread(target=worker, args=(i, s))
+                   for i, s in enumerate(sessions)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(120)
+        for s in sessions:
+            assert len(s.stats.tracing.traces()) <= 4
+            assert all(t.leaked == 0
+                       for t in s.stats.tracing.traces())
+        assert open_span_count() == 0
+        for s in sessions:
+            s.close()
+        seed.close()
+
+    def test_sampling_records_histograms_for_every_statement(
+            self, tmp_path):
+        sess = _mk(str(tmp_path / "d"), trace_sample_every=5)
+        _seed(sess, n=200)
+        r0 = len(sess.stats.tracing.traces())
+        for i in range(10):
+            sess.execute(f"SELECT count(*) FROM kv WHERE v = {i}")
+        sampled = len(sess.stats.tracing.traces()) - r0
+        assert sampled <= 3  # ~1 in 5 record a tree
+        rows = {r["statement_class"]: r
+                for r in sess.stats.tracing.latency_rows()}
+        cls = [c for c in rows if "count" in c and "kv" in c]
+        assert cls and rows[cls[0]]["calls"] == 10  # hist sees ALL
+        sess.close()
+
+    def test_fast_class_auto_degrade_still_samples_trees(self):
+        """Regression (review): the auto-degrade tick stream must be
+        independent of trace_sample_every's — with an even
+        trace_sample_every the shared counter aliased the two modulos
+        and proven-fast classes recorded ZERO trees instead of
+        1-in-N."""
+        from citus_tpu.config import Settings
+        from citus_tpu.stats.tracing import TraceRecorder
+
+        rec = TraceRecorder(None, Settings({
+            "trace_sample_every": 2,
+            "trace_fast_statement_ms": 10_000,  # every class "fast"
+            "trace_fast_sample_every": 16,
+            "trace_ring_statements": 1000}))
+        for _ in range(400):
+            rec.end(rec.begin("select 1"))
+        rows = rec.latency_rows()
+        assert rows and rows[0]["calls"] == 400
+        # ~400/2 survive manual sampling, ~1/16 of those record —
+        # anything >0 proves the streams no longer alias
+        recorded = len(rec.traces())
+        assert 0 < recorded < 40, recorded
+
+    def test_trace_enabled_off_records_nothing(self, tmp_path):
+        sess = _mk(str(tmp_path / "d"), trace_enabled=False)
+        _seed(sess, n=200)
+        sess.execute("SELECT count(*) FROM kv")
+        assert sess.stats.tracing.traces() == []
+        assert sess.stats.tracing.latency_rows() == []
+        assert open_span_count() == 0
+        sess.close()
+
+
+class TestLatencyHistograms:
+    def test_citus_stat_latency_quantiles_honest(self, tmp_path):
+        sess = _mk(str(tmp_path / "d"))
+        _seed(sess, n=300)
+        sql = "SELECT sum(v) FROM kv"
+        for _ in range(12):
+            sess.execute(sql)
+        r = sess.execute("SELECT citus_stat_latency()")
+        assert r.column_names[:2] == ["statement_class", "calls"]
+        rows = {row[0]: row for row in r.rows()}
+        key = [k for k in rows if "sum" in k and "kv" in k]
+        assert key, rows.keys()
+        row = rows[key[0]]
+        cols = dict(zip(r.column_names, row))
+        assert cols["calls"] == 12
+        assert 0 < cols["p50_ms"] <= cols["p95_ms"] <= cols["p99_ms"]
+        # DDSketch relative-error bound (α ≈ 1%) against the recorded
+        # max: p99 of 12 samples cannot exceed the max bucket
+        assert cols["p99_ms"] <= cols["max_ms"] * 1.02
+        # the UDF surface is resettable (the reset statement itself
+        # records afterward — always-on means always-on)
+        sess.execute("SELECT citus_stat_latency_reset()")
+        after = [row[0] for row in sess.execute(
+            "SELECT citus_stat_latency()").rows()]
+        assert key[0] not in after
+        sess.close()
+
+
+# ---------------------------------------------------------------------------
+# slow-query log + chrome export + EXPLAIN Timing (the acceptance
+# shape at test scale; bench.py runs it at SF10)
+# ---------------------------------------------------------------------------
+class TestSlowLogAndExport:
+    def test_slow_log_persists_and_chrome_sums_to_wall(self, tmp_path):
+        from citus_tpu.stats.trace_export import (
+            chrome_trace_events,
+            load_trace,
+        )
+
+        d = str(tmp_path / "d")
+        sess = _mk(d, trace_slow_statement_ms=1)
+        _seed(sess)
+        sess.executor.feed_cache.clear()
+        sess.execute("SELECT sum(v), sum(w) FROM kv WHERE v > 2")
+        assert os.path.isdir(os.path.join(d, "slow_traces"))
+        doc = load_trace(d)
+        _assert_tiles_wall(doc)
+        events = chrome_trace_events(doc)
+        spans = [e for e in events if e.get("ph") == "X"]
+        root = next(e for e in spans if e["name"] == "statement")
+        tops = [e for e in spans
+                if e["name"] in ("parse", "queue", "execute",
+                                 "retry.backoff", "oom.degrade",
+                                 "mesh.degrade")]
+        # acceptance: exported top-level spans sum to wall within 5%
+        # (small statements get a small absolute allowance for glue)
+        covered = sum(e["dur"] for e in tops)
+        assert covered <= root["dur"] * 1.001
+        assert root["dur"] - covered <= max(0.05 * root["dur"], 5000)
+        sess.close()
+
+    def test_slow_log_bounded(self, tmp_path):
+        from citus_tpu.stats.tracing import SLOW_TRACE_KEEP
+
+        d = str(tmp_path / "d")
+        sess = _mk(d, trace_slow_statement_ms=1)
+        _seed(sess, n=200)
+        for i in range(SLOW_TRACE_KEEP + 8):
+            sess.execute(f"SELECT count(*) FROM kv WHERE v = {i % 9}")
+        names = os.listdir(os.path.join(d, "slow_traces"))
+        assert 0 < len(names) <= SLOW_TRACE_KEEP
+        sess.close()
+
+    def test_explain_analyze_timing_line(self, tmp_path):
+        sess = _mk(str(tmp_path / "d"))
+        _seed(sess, n=500)
+        r = sess.execute(
+            "EXPLAIN ANALYZE SELECT count(*), sum(v) FROM kv")
+        lines = [x for x in r.columns["QUERY PLAN"]
+                 if x.startswith("Timing:")]
+        assert len(lines) == 1, r.columns["QUERY PLAN"]
+        line = lines[0]
+        assert "total=" in line and "plan=" in line
+        assert "device=" in line
+        # phases come from the registered span names (registry-synced)
+        sess.close()
+
+    def test_phase_breakdown_never_double_counts(self, tmp_path):
+        sess = _mk(str(tmp_path / "d"))
+        _seed(sess, n=500)
+        sess.executor.feed_cache.clear()
+        sess.execute("SELECT sum(v) FROM kv")
+        doc = sess.stats.tracing.last_trace()
+        ph = phase_breakdown(doc["root"])
+        attributed = sum(v for k, v in ph.items()
+                         if k not in ("total", "other"))
+        assert attributed <= ph["total"] * 1.001
+        assert ph["other"] >= 0
+        sess.close()
