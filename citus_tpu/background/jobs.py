@@ -121,15 +121,14 @@ class BackgroundJobRunner:
     # -- execution ---------------------------------------------------------
     def _claim(self) -> BackgroundTask | None:
         for job in self._jobs.values():
+            # ONE copy of the dependency-cancel rule (failed tasks
+            # also apply it eagerly at failure time; this is the
+            # claim-time belt)
+            self._cancel_dependents_locked(job)
             for t in job.tasks.values():
                 if t.status is not JobStatus.SCHEDULED:
                     continue
                 deps = [job.tasks[d] for d in t.depends_on]
-                if any(d.status in (JobStatus.FAILED, JobStatus.CANCELLED)
-                       for d in deps):
-                    t.status = JobStatus.CANCELLED
-                    t.error = "dependency failed"
-                    continue
                 if all(d.status is JobStatus.DONE for d in deps):
                     t.status = JobStatus.RUNNING
                     return t
@@ -165,7 +164,35 @@ class BackgroundJobRunner:
                     task.status = JobStatus.FAILED
                     task.error = "".join(traceback.format_exception_only(
                         type(exc), exc)).strip()
+                    # cancel dependents EAGERLY, before the notify: the
+                    # job's derived status flips FAILED the moment this
+                    # task does, and a wait()er reading the task table
+                    # right then must not see dependents still
+                    # SCHEDULED (they only became CANCELLED at some
+                    # worker's next _claim() sweep — a racy window)
+                    self._cancel_dependents_locked(
+                        self._jobs.get(task.job_id))
                     self._cv.notify_all()
+
+    def _cancel_dependents_locked(self, job) -> None:
+        """Mark every SCHEDULED task whose dependency chain contains a
+        FAILED/CANCELLED task as CANCELLED (transitively).  Caller
+        holds self._cv."""
+        if job is None:
+            return
+        changed = True
+        while changed:
+            changed = False
+            for t in job.tasks.values():
+                if t.status is not JobStatus.SCHEDULED:
+                    continue
+                deps = [job.tasks[d] for d in t.depends_on]
+                if any(d.status in (JobStatus.FAILED,
+                                    JobStatus.CANCELLED)
+                       for d in deps):
+                    t.status = JobStatus.CANCELLED
+                    t.error = "dependency failed"
+                    changed = True
 
     # -- control (citus_job_wait / citus_job_cancel analogues) -------------
     def wait(self, job_id: int, timeout: float = 3600.0) -> JobStatus:

@@ -162,6 +162,37 @@ _register(ConfigVar(
     "scans still use the device path.",
     int, min_value=0, max_value=1 << 24))
 _register(ConfigVar(
+    "exec_cache_enabled", True,
+    "Persistent compiled-executable cache + single-flight compile "
+    "dedup (executor/execcache.py): serialized AOT executables land "
+    "in <data_dir>/exec_cache/ through the durable-io seam, a fresh "
+    "process loads-doesn't-compile on a plan-cache miss, and N "
+    "sessions racing a cold shape produce ONE compile (followers "
+    "wait under their own statement_timeout_ms/cancel budget).  "
+    "Corrupt/torn/version-skewed entries are detected (CRC + "
+    "environment stamp) and recompile cleanly.  Off restores the "
+    "compile-per-process behavior (the bench cold_start baseline "
+    "arm).  No reference GUC — the analogue is an inference server's "
+    "model-artifact store (PystachIO, PAPERS.md).",
+    bool))
+_register(ConfigVar(
+    "warmup_budget_ms", 0,
+    "Warm-before-admit budget: a fresh session pre-adopts the "
+    "persisted executable cache's hottest shapes (warmup_top_shapes) "
+    "while the workload manager holds non-exempt admissions, for at "
+    "most this long — then the hold auto-expires and the remainder "
+    "loads lazily (graceful degradation, never an indefinite block). "
+    "0 disables the hold (executables still load lazily on demand). "
+    "No reference GUC — the analogue is a serving replica reporting "
+    "ready only after model load.",
+    int, min_value=0, max_value=600_000))
+_register(ConfigVar(
+    "warmup_top_shapes", 8,
+    "How many of the persisted executable cache's hottest entries "
+    "(by hit count, then recency) the warm-before-admit phase "
+    "pre-adopts (see warmup_budget_ms).",
+    int, min_value=1, max_value=4096))
+_register(ConfigVar(
     "max_cached_plans", 256,
     "Compiled-executable cache entries; a structurally repeated query "
     "skips XLA trace+compile (ref: planner/local_plan_cache.c:1-60).",

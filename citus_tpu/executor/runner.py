@@ -142,6 +142,13 @@ class Executor:
         # most ONCE per plan shape, or generic (prepared) plans would
         # recompile on every parameter value's slightly different actuals
         self._tightened_fps: set = set()
+        # caps-memo persistence debounce state: under a compile storm
+        # every memoization used to rewrite the whole memo file
+        # (O(N²) bytes) — writes now coalesce and flush_persistent()
+        # drains the remainder at session close
+        self._memo_dirty = 0
+        self._memo_last_write = 0.0
+        self._memo_writes = 0  # rewrite count (regression-tested)
         # concurrent execute() threads share this executor: the memo
         # dict is iterated while being written (_memoize_caps), which
         # CPython turns into "dict changed size during iteration"
@@ -154,6 +161,14 @@ class Executor:
 
         self.accountant = accountant_for(store.data_dir)
         self.accountant.register_evictable(self.feed_cache)
+        # persistent executable cache + single-flight compile gate:
+        # ONE per data_dir (sessions share the device and the disk) —
+        # a restart loads serialized executables instead of recompiling
+        # and N sessions racing a cold shape produce ONE compile
+        # (executor/execcache.py; gated by `exec_cache_enabled`)
+        from .execcache import exec_cache_for
+
+        self.exec_cache = exec_cache_for(store.data_dir)
         # scan-pipeline phase accounting (executor/scanpipe.py): the
         # bench drivers reset + read this to stamp prefetch/decode/
         # transfer walls and the bytes-on-wire ratio into the artifact
@@ -338,16 +353,14 @@ class Executor:
                 # named seam: a failure while tracing/compiling must
                 # leave the plan cache without a half-built entry
                 fault_point("executor.plan_cache_fill")
-                with trace_span("compile", cache="miss"):
-                    compiler = PlanCompiler(plan, self.mesh, feeds,
-                                            caps, compute_dtype,
-                                            probe_kernel=probe_kernel,
-                                            group_kernel=group_kernel)
-                    fn, feed_arrays, out_meta, stage_keys = \
-                        compiler.build()
-                shuffle_bytes = compiler.shuffle_bytes
-                self.plan_cache.put(key, (fn, out_meta, stage_keys,
-                                          shuffle_bytes))
+                entry = self._compile_or_load(plan, feeds, caps,
+                                              compute_dtype,
+                                              probe_kernel, group_kernel,
+                                              key)
+                self.plan_cache.put(key, entry)
+                fn, out_meta, stage_keys, shuffle_bytes = entry
+                feed_arrays = flatten_feed_arrays(plan, feeds,
+                                                  compute_dtype)
             else:
                 fn, out_meta, stage_keys, shuffle_bytes = entry
                 with trace_span("compile", cache="hit"):
@@ -515,6 +528,111 @@ class Executor:
                         "a guaranteed OOM")
 
     # ------------------------------------------------------------------
+    def _compile_or_load(self, plan: QueryPlan, feeds, caps: Capacities,
+                         compute_dtype, probe_kernel, group_kernel,
+                         key) -> tuple:
+        """Plan-cache miss resolution, restart-survivable.  The whole
+        resolve — disk load AND compile — runs single-flight through
+        the per-data_dir gate: N sessions hitting a cold shape produce
+        ONE deserialization (the PystachIO one-load-per-replica move)
+        or, when the disk has nothing, ONE compile; followers wait
+        under their own deadline/cancel budget and adopt the leader's
+        executable.  Inside the flight the order is:
+
+        1. the persistent executable cache (``exec_cache_enabled``):
+           load-don't-compile — a deserialized AOT executable replaces
+           trace + XLA compile (corrupt/skewed entries are detected
+           and fall through);
+        2. the compile itself, AOT (lower + compile, so the finished
+           executable is serializable), persisted through the io seam.
+
+        Returns the plan-cache entry ``(fn, out_meta, stage_keys,
+        shuffle_bytes)``."""
+        from ..stats import counters as sc
+        from ..stats.tracing import trace_span
+
+        use_cache = self.settings.get("exec_cache_enabled")
+        ec = self.exec_cache
+
+        def compile_fn():
+            with trace_span("compile", cache="miss"):
+                compiler = PlanCompiler(plan, self.mesh, feeds,
+                                        caps, compute_dtype,
+                                        probe_kernel=probe_kernel,
+                                        group_kernel=group_kernel)
+                fn, feed_arrays, out_meta, stage_keys = \
+                    compiler.build()
+                # AOT: compile NOW (not lazily at first dispatch) so
+                # the executable exists to serialize and to hand to
+                # deduped followers
+                fn = fn.lower(*feed_arrays).compile()
+            ec.note_compile()  # actual-compile ledger (dedup asserts)
+            entry = (fn, out_meta, stage_keys, compiler.shuffle_bytes)
+            if use_cache:
+                ec.store(key, self.mesh, *entry)
+            return entry
+
+        if not use_cache:
+            return compile_fn()
+
+        def resolve_fn():
+            with trace_span("compile.cache_load"):
+                entry, status = ec.load(key, self.mesh)
+            if self.counters is not None:
+                if status == "hit":
+                    self.counters.increment(sc.EXEC_CACHE_HITS_TOTAL)
+                elif status == "reject":
+                    # detected rot/skew: recorded, then recompiled
+                    self.counters.increment(sc.EXEC_CACHE_REJECTS_TOTAL)
+                else:
+                    self.counters.increment(sc.EXEC_CACHE_MISSES_TOTAL)
+            if entry is not None:
+                return entry
+            return compile_fn()
+
+        entry, deduped = ec.gate.run(key, resolve_fn)
+        if deduped and self.counters is not None:
+            self.counters.increment(sc.COMPILES_DEDUPED_TOTAL)
+        return entry
+
+    # ------------------------------------------------------------------
+    def warmup_from_cache(self, deadline: float, top_n: int,
+                          stop=None) -> int:
+        """Warm-before-admit: pre-adopt the persisted cache's hottest
+        executables into this executor's plan cache before the WLM
+        admits non-exempt traffic (Session starts this on a warmup
+        thread; the admission hold auto-expires at `deadline`).  Runs
+        until the entries or the monotonic `deadline` run out —
+        overrun or a fault degrades gracefully to lazy loading, never
+        blocks admission forever.  Returns executables adopted."""
+        import time as _time
+
+        from ..stats import counters as sc
+        from ..stats.tracing import trace_span
+        from ..utils.faultinjection import fault_point
+
+        loaded = 0
+        for h in self.exec_cache.top_hashes(max(0, top_n)):
+            if _time.monotonic() >= deadline or \
+                    (stop is not None and stop.is_set()):
+                # budget spent or the owning session is closing (the
+                # admission hold must not outlive it): lazy from here
+                break
+            try:
+                fault_point("wlm.warmup")
+                with trace_span("wlm.warmup"):
+                    key, entry = self.exec_cache.load_hash(h, self.mesh)
+            except Exception:  # graftlint: ignore[swallowed-fault-seam] — not swallowed into silence: a warmup failure (injected or real) degrades to lazy compile by design; the admission hold releases in the caller's finally
+                break
+            if entry is None:
+                continue  # skewed/corrupt entry: lazy path rejects too
+            self.plan_cache.put(key, entry)
+            loaded += 1
+            if self.counters is not None:
+                self.counters.increment(sc.WARMUP_COMPILES_TOTAL)
+        return loaded
+
+    # ------------------------------------------------------------------
     def adopt_mesh(self, mesh: Mesh) -> None:
         """Swap in a (usually shrunken) mesh after device loss or an
         elastic resize — the session's mesh-degrade path calls this
@@ -667,25 +785,22 @@ class Executor:
     # JSON round-trips it (lists→tuples, int keys re-parsed) without the
     # arbitrary-code-execution hazard pickle.load would add to a SHARED
     # data_dir (every other persisted artifact here is JSON for the same
-    # reason)
+    # reason).  ONE codec, shared with the executable cache's key
+    # encoding (executor/execcache.py) — the two used to be copies and
+    # diverged on numpy-scalar coercion, which made memo persistence
+    # silently fail (TypeError swallowed below) for fingerprints
+    # carrying np.int64 key extents.
     @staticmethod
     def _memo_to_json(obj):
-        if isinstance(obj, tuple):
-            return {"t": [Executor._memo_to_json(x) for x in obj]}
-        if isinstance(obj, dict):
-            return {"d": [[Executor._memo_to_json(k),
-                           Executor._memo_to_json(v)]
-                          for k, v in obj.items()]}
-        return obj
+        from .execcache import key_to_json
+
+        return key_to_json(obj)
 
     @staticmethod
     def _memo_from_json(obj):
-        if isinstance(obj, dict) and "t" in obj:
-            return tuple(Executor._memo_from_json(x) for x in obj["t"])
-        if isinstance(obj, dict) and "d" in obj:
-            return {Executor._memo_from_json(k):
-                    Executor._memo_from_json(v) for k, v in obj["d"]}
-        return obj
+        from .execcache import key_from_json
+
+        return key_from_json(obj)
 
     def _load_caps_memo(self) -> dict:
         import json as _json
@@ -703,19 +818,61 @@ class Executor:
             pass
         return {}
 
+    # memo bounds + rewrite debounce: overflow evicts the OLDEST HALF
+    # (a full clear() forgot every converged shape at once — a
+    # self-inflicted cold start), and the whole-file rewrite coalesces
+    # under a compile storm (every memoization used to rewrite O(N)
+    # bytes — O(N²) across a storm).  A lone memoization past the idle
+    # window still writes immediately; close() drains the remainder
+    # via flush_persistent().
+    CAPS_MEMO_MAX = 512
+    CAPS_MEMO_FLUSH_EVERY = 8
+    CAPS_MEMO_FLUSH_IDLE_S = 0.25
+
     def _memoize_caps(self, fingerprint, plan: QueryPlan,
                       caps: Capacities) -> None:
+        self._caps_memo_insert(fingerprint,
+                               self._caps_to_order(plan, caps))
+
+    def _caps_memo_insert(self, fingerprint, ordered) -> None:
+        import time as _time
+
+        with self._caps_lock:
+            if fingerprint not in self._caps_memo and \
+                    len(self._caps_memo) >= self.CAPS_MEMO_MAX:
+                # evict the oldest half (dict insertion order): the
+                # newest converged shapes — the live working set under
+                # a storm — stay warm
+                for k in list(self._caps_memo)[
+                        :len(self._caps_memo) // 2]:
+                    del self._caps_memo[k]
+            # LRU, not insertion-order: a re-memoized hot shape must
+            # move to the young end or the overflow above would evict
+            # it as "oldest" despite being actively refreshed
+            self._caps_memo.pop(fingerprint, None)
+            self._caps_memo[fingerprint] = ordered
+            self._memo_dirty += 1
+            now = _time.monotonic()
+            if self._memo_dirty < self.CAPS_MEMO_FLUSH_EVERY and \
+                    now - self._memo_last_write < \
+                    self.CAPS_MEMO_FLUSH_IDLE_S:
+                return  # coalesce: a later insert or close() flushes
+        self._flush_caps_memo()
+
+    def _flush_caps_memo(self) -> None:
         import contextlib
         import os
+        import time as _time
 
         from ..utils.io import atomic_write_json
 
         # snapshot under the lock (concurrent statements memoize while
         # this thread serializes the items), write the file outside it
         with self._caps_lock:
-            if len(self._caps_memo) > 512:
-                self._caps_memo.clear()
-            self._caps_memo[fingerprint] = self._caps_to_order(plan, caps)
+            if not self._memo_dirty:
+                return
+            self._memo_dirty = 0
+            self._memo_last_write = _time.monotonic()
             payload = [[self._memo_to_json(k), self._memo_to_json(v)]
                        for k, v in self._caps_memo.items()]
         try:
@@ -723,6 +880,7 @@ class Executor:
                 self._memo_path(),
                 {"version": self.CAPS_MEMO_VERSION,
                  "memo": payload})
+            self._memo_writes += 1
             # complete the pkl→json migration: the pickle predecessor
             # must not linger in a shared data_dir
             with contextlib.suppress(OSError):
@@ -730,6 +888,13 @@ class Executor:
                                        "caps_memo.pkl"))
         except (OSError, TypeError, ValueError):
             pass  # persistence is best-effort; in-memory memo suffices
+
+    def flush_persistent(self) -> None:
+        """Drain debounced persistence (caps memo, exec-cache hotness
+        index) — Session.close() calls this so a clean shutdown leaves
+        the warm-start state current on disk."""
+        self._flush_caps_memo()
+        self.exec_cache.flush_index()
 
     # ------------------------------------------------------------------
     # feedback sizing: actual×slack, with headroom so equal-sized reruns

@@ -208,6 +208,36 @@ class Session:
             wlm=self.wlm, wlm_request=self._wlm_background_request)
         self.maintenance = MaintenanceDaemon(self)
         self.maintenance.start()
+        # warm-before-admit (executor/execcache.py): a restarted
+        # process with a populated executable cache pre-adopts its
+        # hottest shapes while the WLM holds non-exempt admissions —
+        # bounded by warmup_budget_ms (the hold auto-expires, so an
+        # overrun degrades to lazy loading, never an admission block)
+        self._warmup_thread = None
+        self._warmup_stop = threading.Event()
+        import time as _time
+
+        warm_ms = self.settings.get("warmup_budget_ms")
+        if warm_ms > 0 and self.settings.get("exec_cache_enabled") \
+                and self.executor.exec_cache.has_entries():
+            deadline = _time.monotonic() + warm_ms / 1000.0
+            self.wlm.hold_admissions(deadline)
+            self._warmup_thread = threading.Thread(
+                target=self._run_warmup, args=(deadline,),
+                name="citus-tpu-warmup", daemon=True)
+            self._warmup_thread.start()
+
+    def _run_warmup(self, deadline: float) -> None:
+        """Warmup-thread body (session-owned; close() signals the stop
+        event and joins it — the admission hold on the SHARED workload
+        manager must not outlive the session that requested it): adopt
+        persisted executables, then ALWAYS release the hold."""
+        try:
+            self.executor.warmup_from_cache(
+                deadline, self.settings.get("warmup_top_shapes"),
+                stop=self._warmup_stop)
+        finally:
+            self.wlm.release_admissions()
 
     # -- public API --------------------------------------------------------
     def execute(self, sql: str):
@@ -831,9 +861,18 @@ class Session:
         self._save_catalog()
 
     def close(self):
+        if self._warmup_thread is not None:
+            self._warmup_stop.set()  # stop between adoptions
+            self._warmup_thread.join(timeout=5.0)
+            self._warmup_thread = None
         self.maintenance.stop()
         self.jobs.shutdown()
         self._save_catalog()
+        # drain debounced warm-start persistence (caps memo rewrites
+        # coalesce under compile storms; the exec-cache hotness index
+        # flushes every N touches) so a clean shutdown leaves the
+        # restart-survival state current on disk
+        self.executor.flush_persistent()
         with self._result_cache_mu:
             handle, self._result_cache_handle = \
                 self._result_cache_handle, None
@@ -2043,15 +2082,33 @@ class Session:
                 # counters live on PlanCache/FeedCache; deltas follow
                 # the Chunks Skipped pattern), plus session totals so
                 # warm-vs-cold is auditable from one EXPLAIN ANALYZE
+                # the executable-cache hit state rides the same line:
+                # exec-cache hits are restart-survival loads (a compile
+                # skipped by deserializing a persisted executable),
+                # deduped are compiles another session led
+                d_ech = snap.get(sc.EXEC_CACHE_HITS_TOTAL, 0) - \
+                    snap0.get(sc.EXEC_CACHE_HITS_TOTAL, 0)
+                d_ecm = snap.get(sc.EXEC_CACHE_MISSES_TOTAL, 0) - \
+                    snap0.get(sc.EXEC_CACHE_MISSES_TOTAL, 0)
+                d_ecr = snap.get(sc.EXEC_CACHE_REJECTS_TOTAL, 0) - \
+                    snap0.get(sc.EXEC_CACHE_REJECTS_TOTAL, 0)
+                d_dd = snap.get(sc.COMPILES_DEDUPED_TOTAL, 0) - \
+                    snap0.get(sc.COMPILES_DEDUPED_TOTAL, 0)
                 lines.append(
                     f"{explain_tag('Caches')}: plan-cache hits="
                     f"{pc.hits - cache0[0]} misses="
                     f"{pc.misses - cache0[1]}  feed-cache hits="
                     f"{fc.hits - cache0[2]} misses="
-                    f"{fc.misses - cache0[3]} (session totals: plan "
+                    f"{fc.misses - cache0[3]}  exec-cache hits="
+                    f"{d_ech} misses={d_ecm} rejects={d_ecr} "
+                    f"deduped={d_dd} (session totals: plan "
                     f"{pc.hits}/{pc.misses}, feed {fc.hits}/{fc.misses}"
                     f" hits/misses, feed invalidations="
-                    f"{fc.invalidations})")
+                    f"{fc.invalidations}, exec-cache "
+                    f"{snap.get(sc.EXEC_CACHE_HITS_TOTAL, 0)}/"
+                    f"{snap.get(sc.EXEC_CACHE_MISSES_TOTAL, 0)} "
+                    "hits/misses, warmup_compiles_total="
+                    f"{snap.get(sc.WARMUP_COMPILES_TOTAL, 0)})")
                 # this statement's trip through the admission gate (the
                 # EXPLAIN ANALYZE statement itself was the admitted
                 # unit), plus session totals like the Resilience line

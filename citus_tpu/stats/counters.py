@@ -77,6 +77,16 @@ SERVING_BATCH_DISPATCH_TOTAL = "serving_batch_dispatch_total"
 SERVING_CACHE_HITS_TOTAL = "serving_cache_hits_total"
 SERVING_CACHE_MISSES_TOTAL = "serving_cache_misses_total"
 SERVING_CACHE_INVALIDATIONS_TOTAL = "serving_cache_invalidations_total"
+# persistent executable cache + single-flight compile dedup + warm-
+# before-admit (executor/execcache.py): disk adoptions vs cold misses
+# vs detected-rot rejects, compiles saved by following another
+# session's in-flight compile, and executables pre-adopted by the
+# warmup phase before admission opened
+EXEC_CACHE_HITS_TOTAL = "exec_cache_hits_total"
+EXEC_CACHE_MISSES_TOTAL = "exec_cache_misses_total"
+EXEC_CACHE_REJECTS_TOTAL = "exec_cache_rejects_total"
+COMPILES_DEDUPED_TOTAL = "compiles_deduped_total"
+WARMUP_COMPILES_TOTAL = "warmup_compiles_total"
 # device-memory governance (executor/hbm.py accountant + the OOM
 # degradation ladder in executor/runner.py degrade_for_oom)
 OOM_EVENTS_TOTAL = "oom_events_total"
@@ -110,6 +120,9 @@ ALL_COUNTERS = [
     SERVING_BATCHED_LOOKUPS_TOTAL, SERVING_BATCH_DISPATCH_TOTAL,
     SERVING_CACHE_HITS_TOTAL, SERVING_CACHE_MISSES_TOTAL,
     SERVING_CACHE_INVALIDATIONS_TOTAL,
+    EXEC_CACHE_HITS_TOTAL, EXEC_CACHE_MISSES_TOTAL,
+    EXEC_CACHE_REJECTS_TOTAL, COMPILES_DEDUPED_TOTAL,
+    WARMUP_COMPILES_TOTAL,
     OOM_EVENTS_TOTAL, CACHE_EVICTIONS_TOTAL,
     STREAM_BATCH_SHRINKS_TOTAL, SPILL_PASSES_TOTAL,
     STRIPES_VERIFIED_TOTAL, CORRUPTION_DETECTED_TOTAL,

@@ -67,6 +67,13 @@ SPAN_NAMES: dict[str, str] = {
     "feed": "device feed build (eager, pipelined or per-batch)",
     "compile": "plan-cache resolution (meta cache=hit|miss; a miss "
                "traces + XLA-compiles the mesh program)",
+    "compile.cache_load": "persistent executable cache probe: meta + "
+                          "CRC verify + AOT deserialize on a hit",
+    "compile.single_flight_wait": "follower waiting on another "
+                                  "session's in-flight compile of the "
+                                  "same shape (compile dedup)",
+    "wlm.warmup": "warm-before-admit: one persisted executable "
+                  "adopted into the plan cache pre-admission",
     "mesh.dispatch": "compiled program dispatch + on-mesh collectives",
     "mesh.fetch": "device→host pull of outputs + overflow counters",
     "combine": "host-side combine (having/order/limit/decode)",
@@ -98,6 +105,8 @@ PHASE_OF: dict[str, str] = {
     "plan": "plan",
     "feed": "feed",
     "compile": "compile",
+    "compile.cache_load": "compile",
+    "compile.single_flight_wait": "compile",
     "mesh.dispatch": "device",
     "mesh.fetch": "device",
     "combine": "combine",

@@ -83,6 +83,18 @@ FAULT_POINTS: dict[str, str] = {
     "executor.hbm_exhausted":
         "executor/hbm.py — accounted placement seam (arm with "
         "error='oom' for a synthetic allocator RESOURCE_EXHAUSTED)",
+    "executor.exec_cache_load":
+        "executor/execcache.py — persisted-executable adoption (an "
+        "injected fault models rot: the load downgrades to a counted "
+        "reject + clean recompile, never a crash)",
+    "executor.exec_cache_store":
+        "executor/execcache.py — serialized-executable persist (fires "
+        "before the best-effort catch, so an injected fault errors "
+        "the statement cleanly and the retry recompiles)",
+    "wlm.warmup":
+        "executor/runner.py — warm-before-admit executable adoption "
+        "(a fault degrades warmup to lazy loading; the admission "
+        "hold always releases)",
     "mesh.device_put":
         "distributed/mesh.py — per-device host→HBM transfer (arm with "
         "error='device' for a synthetic device loss; MeshSim kills "

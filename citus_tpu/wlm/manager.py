@@ -150,6 +150,14 @@ class WorkloadManager:
         # last-seen gate limits (display only — limits ride each request)
         self._last_max_slots = 0
         self._last_max_feed = 0
+        # warm-before-admit hold (executor/execcache.py warmup): while
+        # > 0 holds are active AND the deadline has not passed,
+        # non-exempt admissions wait — a fresh process pre-adopts its
+        # persisted executables before taking traffic.  The deadline is
+        # the graceful-degradation valve: warmup overrun can never
+        # block admission forever (it expires even if the holder dies)
+        self._warm_holds = 0
+        self._warm_deadline = 0.0
         # measured device-byte pressure source: workload_manager_for
         # attaches the data_dir's DeviceMemoryAccountant
         # (executor/hbm.py), so the gate admits against
@@ -160,6 +168,44 @@ class WorkloadManager:
 
     def attach_measured(self, cb) -> None:
         self._measured_cb = cb
+
+    # -- warm-before-admit -------------------------------------------------
+    def hold_admissions(self, deadline: float) -> None:
+        """Gate non-exempt admissions behind a warmup phase until
+        release_admissions() or the monotonic `deadline`, whichever
+        comes first (warmup_budget_ms caps the hold)."""
+        with self._cv:
+            self._warm_holds += 1
+            self._warm_deadline = max(self._warm_deadline, deadline)
+
+    def release_admissions(self) -> None:
+        with self._cv:
+            self._warm_holds = max(0, self._warm_holds - 1)
+            if not self._warm_holds:
+                # reset the deadline with the last hold: a later hold
+                # must not inherit a stale larger deadline via max()
+                # (its auto-expire bound would exceed its own budget)
+                self._warm_deadline = 0.0
+                self._cv.notify_all()
+
+    def warming(self) -> bool:
+        with self._cv:
+            return bool(self._warm_holds and
+                        time.monotonic() < self._warm_deadline)
+
+    def _wait_warm(self) -> None:
+        """Block while a warmup hold is active (deadline/cancel-aware:
+        check_cancel runs every wait slice, and the hold auto-expires
+        at its deadline so admission degrades to lazy loading)."""
+        from ..utils.cancellation import check_cancel
+
+        while True:
+            with self._cv:
+                if not self._warm_holds or \
+                        time.monotonic() >= self._warm_deadline:
+                    return
+                self._cv.wait(0.02)
+            check_cancel()
 
     # -- admission ---------------------------------------------------------
     def admit(self, req: AdmissionRequest) -> Ticket:
@@ -173,6 +219,11 @@ class WorkloadManager:
         # injected fault leaks neither a slot nor a queue entry (and
         # the requests ledger only counts requests that entered)
         fault_point("wlm.admit")
+        # warm-before-admit: a fresh process pre-adopts its persisted
+        # executables before non-exempt traffic lands on cold caches
+        # (exempt statements never reach admit(), so fast-path point
+        # reads flow throughout)
+        self._wait_warm()
         with self._cv:
             self.requests_total += 1
             self._last_max_slots = req.max_slots
@@ -365,6 +416,8 @@ class WorkloadManager:
             return {
                 "slots_in_use": self._running,
                 "slots_total": self._last_max_slots,
+                "warming": bool(self._warm_holds and
+                                time.monotonic() < self._warm_deadline),
                 "feed_bytes_admitted": self._feed_inflight,
                 "feed_bytes_limit": self._last_max_feed,
                 "requests_total": self.requests_total,

@@ -29,7 +29,7 @@ import pytest  # noqa: E402
 # stable).  tools/t1_times.py reports per-file costs and where the
 # budget cutoff lands.
 _TIER1_FIRST = ("test_lint.py", "test_tools.py", "test_wlm.py",
-                "test_tracing.py",
+                "test_tracing.py", "test_exec_cache.py",
                 "test_multichip.py", "test_mesh_failover.py",
                 "test_scan_pipeline.py",
                 "test_serving.py", "test_integrity.py",

@@ -89,6 +89,13 @@ FAULT_POOL = [
     dict(name="executor.scan_prefetch"),
     dict(name="executor.scan_prefetch", p=0.5, times=2),
     dict(name="executor.device_decode"),
+    # executable-cache seams (PR 15): injected rot at the load seam
+    # must downgrade to a counted reject + clean recompile (never a
+    # crash, never a stale executable); a store fault errors the
+    # compiling statement cleanly and its retry recompiles
+    dict(name="executor.exec_cache_load"),
+    dict(name="executor.exec_cache_load", p=0.5, times=2),
+    dict(name="executor.exec_cache_store"),
     # mesh seams (PR 13): an armed error='device' raises a
     # DeviceLostError that names no corpse — the session's probe pass
     # must find every fake device alive (a link flap) and re-run on
@@ -165,7 +172,7 @@ def _run_soak_inner(tmp_path, n_ops: int, seed: int, fault_rate: float):
     model.update(seed_rows)
 
     stats = {"ops": 0, "stmts": 0, "armed": 0, "clean_failures": 0,
-             "reconciled": 0, "device_kills": 0}
+             "reconciled": 0, "device_kills": 0, "restarts": 0}
     # device-killer victims: ids >= 2 only — the 2-device sessions own
     # ids {0, 1} and the reconcile/checksum paths run through them, so
     # the 8-device session takes the losses (and shrinks across the
@@ -173,8 +180,32 @@ def _run_soak_inner(tmp_path, n_ops: int, seed: int, fault_rate: float):
     import jax as _jax
 
     kill_pool = [d.id for d in _jax.devices() if d.id >= 2]
+    restart_at = n_ops // 2
     while stats["ops"] < n_ops:
         stats["ops"] += 1
+        if stats["ops"] == restart_at:
+            # mid-soak deploy: bounce a session under live traffic.
+            # The restarted process must RESUME from the persisted
+            # executable cache (PR 15) — the probe shape compiled
+            # before the bounce loads, not recompiles, after it.
+            # (result cache off for BOTH probe executions: a cache-
+            # served answer — from this probe text or any earlier
+            # statement that matched it — would skip the executor and
+            # nothing would be compiled/persisted for this shape)
+            probe = "SELECT id, v FROM kv WHERE id >= 0"
+            with sessions[0].settings.override(
+                    serving_result_cache_bytes=0):
+                sessions[0].execute(probe)
+            sessions[0].close()
+            sessions[0] = mk(scan_pipeline="off",
+                             serving_result_cache_bytes=0)
+            sessions[0].execute(probe)
+            from citus_tpu.stats import counters as _sc
+
+            assert sessions[0].stats.counters.snapshot()[
+                _sc.EXEC_CACHE_HITS_TOTAL] >= 1, \
+                "restarted session recompiled a persisted shape"
+            stats["restarts"] += 1
         sess = sessions[stats["ops"] % len(sessions)]
         script = generate_chaos(rng, state, model)
         armed = None
@@ -310,6 +341,7 @@ class TestChaosSoak:
         """Deterministic-seed smoke slice: small enough for tier-1."""
         stats = _run_soak(tmp_path, n_ops=45, seed=1234, fault_rate=0.35)
         assert stats["armed"] >= 8  # soak actually injected chaos
+        assert stats["restarts"] == 1  # the mid-soak bounce happened
 
     @pytest.mark.slow
     def test_full_soak(self, tmp_path):

@@ -50,7 +50,7 @@ def by_file(totals: dict[str, float]) -> dict[str, float]:
 # must mirror tests/conftest.py::_TIER1_FIRST — the collection hook
 # runs these files before the alphabetical remainder
 TIER1_FIRST = ("test_lint.py", "test_tools.py", "test_wlm.py",
-               "test_tracing.py",
+               "test_tracing.py", "test_exec_cache.py",
                "test_multichip.py", "test_mesh_failover.py",
                "test_scan_pipeline.py",
                "test_serving.py", "test_integrity.py",
