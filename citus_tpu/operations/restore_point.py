@@ -186,3 +186,10 @@ def restore_cluster(data_dir: str, name: str) -> None:
     from ..serving.result_cache import reset_serving_state
 
     reset_serving_state(data_dir)
+    # the journal just regressed wholesale: any follower cursor now
+    # points past the wipe.  A new timeline id makes every next ship a
+    # reseed, so followers restage from scratch instead of applying
+    # deltas from a history that no longer exists.
+    from ..replication import rotate_history
+
+    rotate_history(data_dir)

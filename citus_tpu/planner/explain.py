@@ -58,6 +58,8 @@ EXPLAIN_TAGS: dict[str, str] = {
     "Caches": "plan/feed cache traffic for this statement",
     "Workload": "admission-gate trip for this statement",
     "Serving": "micro-batch / result-cache trip for this statement",
+    "Replication": "replica role, applied lsn and visible staleness "
+                   "(followers) or follower fleet state (leaders)",
 }
 
 

@@ -59,6 +59,8 @@ _UDFS = ("create_distributed_table", "create_reference_table",
          "citus_change_feed", "citus_create_restore_point",
          "citus_check_cluster_node_health", "citus_promote_node",
          "citus_check_cluster",
+         "citus_stat_replication", "citus_replication_ship",
+         "citus_promote_replica",
          "nextval", "currval",
          "citus_tables", "citus_shards")
 
@@ -183,6 +185,9 @@ class Session:
         # per-thread record of the last admission (EXPLAIN ANALYZE's
         # Workload: line reads it after the admitted statement planned)
         self._wlm_tls = threading.local()
+        # per-thread record of the last follower staleness check
+        # (EXPLAIN ANALYZE's Replication: line)
+        self._replica_stale_tls = threading.local()
         # transaction coordinator + shared lock table; interrupted 2PCs
         # from a previous process roll forward/back NOW, before any read
         # (the maintenance-daemon recovery pass at backend start;
@@ -198,6 +203,20 @@ class Session:
         from .operations.cleanup import cleanup_registry_for
 
         cleanup_registry_for(self.data_dir).sweep(self.store, self.catalog)
+        # replication role (replication/): a follower data_dir drains
+        # any batches shipped while no session was open, BEFORE serving
+        # (the same open-time catch-up 2PC recovery just did for the
+        # leader-local txnlog), then re-reads its catalog — the shipped
+        # one supersedes whatever this constructor loaded
+        from .replication import apply_pending, replication_for
+
+        self.replication = replication_for(self.data_dir)
+        if self.replication.is_follower():
+            res = apply_pending(self.data_dir,
+                                counters=self.stats.counters,
+                                store=self.store)
+            if res["applied"]:
+                self.catalog.maybe_reload(cat_path)
         # background services: job runner (pg_dist_background_task
         # executors) + maintenance daemon (2PC recovery, deferred cleanup,
         # deadlock checks — utils/maintenanced.c:460)
@@ -881,6 +900,28 @@ class Session:
 
             release_result_cache(self.data_dir)
 
+    # -- replication -------------------------------------------------------
+    def promote_replica(self) -> int:
+        """Promote this follower data_dir to leader (leader-death
+        failover): roll the shipped journal forward, bump the fencing
+        epoch (stamping the old leader's dir so a zombie's late ship is
+        rejected), flip the role record, then run the PR-7 recovery
+        machinery — 2PC recovery + the cleanup sweep — through this
+        session's own managers and adopt the rolled-forward catalog.
+        Returns the new epoch; this session accepts writes from the
+        next statement on."""
+        from .operations.cleanup import cleanup_registry_for
+        from .replication import promote
+
+        epoch = promote(self.data_dir, counters=self.stats.counters,
+                        store=self.store)
+        self.txn_manager.recover()
+        cleanup_registry_for(self.data_dir).sweep(self.store,
+                                                  self.catalog)
+        self.catalog.maybe_reload(
+            os.path.join(self.data_dir, "catalog.json"))
+        return epoch
+
     # -- change data capture ----------------------------------------------
     def change_events(self, table: str | None = None,
                       from_lsn: int = 0) -> list[dict]:
@@ -895,7 +936,57 @@ class Session:
         return rows_for(self.store, event)
 
     # -- statement dispatch ------------------------------------------------
+    # statement shapes a follower must refuse (every mutation belongs
+    # on the leader; the journal is the only way data reaches a replica)
+    _REPLICA_WRITE_STMTS = (
+        "InsertValues", "InsertSelect", "Update", "Delete", "Merge",
+        "CopyFrom", "CreateTable", "DropTable", "AlterTable",
+        "CreateView", "DropView", "CreateSequence", "DropSequence")
+    # admin UDFs that mutate catalog/data — equally refused on followers
+    _REPLICA_WRITE_UDFS = frozenset({
+        "create_distributed_table", "create_reference_table",
+        "citus_add_node", "citus_remove_node", "citus_disable_node",
+        "citus_activate_node", "rebalance_table_shards",
+        "citus_move_shard_placement", "citus_split_shard_by_split_points",
+        "isolate_tenant_to_node", "citus_rebalance_start",
+        "citus_rebalance_mesh", "citus_drain_device",
+        "citus_promote_node", "citus_create_restore_point", "nextval"})
+
+    def _replica_gate(self, stmt: ast.Statement) -> None:
+        """Follower-session statement gate: refuse writes cleanly, then
+        drain any shipped batches and bound the VISIBLE staleness
+        before a read plans (replication/applier.ensure_fresh)."""
+        if not self.replication.is_follower():
+            return
+        from .errors import ReadOnlyReplica
+        from .replication import ensure_fresh
+
+        if type(stmt).__name__ in self._REPLICA_WRITE_STMTS:
+            raise ReadOnlyReplica(
+                f"cannot execute {type(stmt).__name__} on a read "
+                "replica — writes belong on the leader "
+                f"({(self.replication.state() or {}).get('leader_dir')})")
+        if isinstance(stmt, ast.Select) and not stmt.from_items and \
+                len(stmt.items) == 1 and \
+                isinstance(stmt.items[0].expr, ast.FuncCall) and \
+                stmt.items[0].expr.name in self._REPLICA_WRITE_UDFS:
+            raise ReadOnlyReplica(
+                f"cannot execute {stmt.items[0].expr.name}() on a read "
+                "replica — cluster mutations belong on the leader")
+        fresh = ensure_fresh(
+            self.data_dir,
+            self.settings.get("replica_max_staleness_lsn"),
+            counters=self.stats.counters, store=self.store)
+        self._replica_stale_tls.last = fresh
+        # an applied batch may have shipped DDL: adopt the leader's
+        # catalog before planning (never mid-transaction — the open
+        # txn pinned its snapshot)
+        if fresh["applied"] and self.txn_manager.current is None:
+            self.catalog.maybe_reload(
+                os.path.join(self.data_dir, "catalog.json"))
+
     def _execute_statement(self, stmt: ast.Statement):
+        self._replica_gate(stmt)
         if isinstance(stmt, ast.Select):
             udf = self._try_udf(stmt)
             if udf is not None:
@@ -1182,6 +1273,73 @@ class Session:
 
             name = create_restore_point(self, str(args[0]))
             return ResultSet(["restore_point"], {"restore_point": [name]}, 1)
+        elif e.name == "citus_replication_ship":
+            # leader-side: stage one batch for every registered
+            # follower (the explicit counterpart of the maintenance
+            # daemon's replication_ship_interval_ms duty)
+            from .replication import ship_all
+
+            rows = ship_all(self.data_dir,
+                            counters=self.stats.counters)
+            cols = {"follower": [r["follower"] for r in rows],
+                    "status": [r["status"] for r in rows],
+                    "batch_seq": [r.get("batch_seq", 0) for r in rows],
+                    "files": [r.get("files", 0) for r in rows],
+                    "bytes": [r.get("bytes", 0) for r in rows]}
+            return ResultSet(list(cols), cols, len(rows))
+        elif e.name == "citus_promote_replica":
+            epoch = self.promote_replica()
+            return ResultSet(["epoch"], {"epoch": [epoch]}, 1)
+        elif e.name == "citus_stat_replication":
+            # per-peer lag in LSNS AND BYTES — the bounded-VISIBLE-
+            # staleness surface (ref: pg_stat_replication +
+            # citus_get_node_clock).  Leaders report one row per
+            # registered follower; followers report one row about
+            # their own cursor vs their leader's journal tail.
+            from .replication import (
+                journal_tail_lsn,
+                load_cursor,
+                staleness,
+            )
+
+            state = self.replication.state()
+            peers, roles, applied, lead, lag_l, lag_b, epochs = \
+                [], [], [], [], [], [], []
+            if state and state.get("role") == "leader":
+                leader_lsn = journal_tail_lsn(self.data_dir)
+                try:
+                    jbytes = os.path.getsize(os.path.join(
+                        self.data_dir, "cdc_changes.jsonl"))
+                except OSError:
+                    jbytes = 0
+                for fdir in state.get("followers", []):
+                    cur = load_cursor(fdir)
+                    a = int(cur["applied_lsn"]) if cur else 0
+                    fb = int(cur["journal_size"]) if cur else 0
+                    peers.append(fdir)
+                    roles.append("follower")
+                    applied.append(a)
+                    lead.append(leader_lsn)
+                    lag_l.append(max(0, leader_lsn - a))
+                    lag_b.append(max(0, jbytes - fb))
+                    epochs.append(int(cur["epoch"]) if cur
+                                  else int(state["epoch"]))
+            elif state and state.get("role") == "follower":
+                s = staleness(self.data_dir)
+                cur = load_cursor(self.data_dir)
+                peers.append(s["leader_dir"] or "")
+                roles.append("leader")
+                applied.append(s["applied_lsn"])
+                lead.append(s["leader_lsn"])
+                lag_l.append(s["lag_lsn"])
+                lag_b.append(s["lag_bytes"])
+                epochs.append(int(cur["epoch"]) if cur
+                              else int(state["epoch"]))
+            cols = {"peer": peers, "peer_role": roles,
+                    "applied_lsn": applied, "leader_lsn": lead,
+                    "lag_lsn": lag_l, "lag_bytes": lag_b,
+                    "epoch": epochs}
+            return ResultSet(list(cols), cols, len(peers))
         elif e.name == "citus_stat_counters":
             snap = self.stats.counters.snapshot()
             names = sorted(snap)
@@ -2170,6 +2328,28 @@ class Session:
                         f"occupancy={bsnap['avg_batch_occupancy']} "
                         f"max_batch_seen={bsnap['max_batch_seen']}; "
                         f"session totals: cache hits={ch} misses={cm})")
+                # replication: this session's role and, on a follower,
+                # the staleness the read gate saw for THIS statement
+                # (never silently old rows — the lag is auditable here)
+                rstate = self.replication.state()
+                if rstate is not None:
+                    role = rstate.get("role")
+                    if role == "follower":
+                        gate = getattr(self._replica_stale_tls, "last",
+                                       None) or {}
+                        lines.append(
+                            f"{explain_tag('Replication')}: "
+                            f"role=follower epoch={rstate['epoch']} "
+                            f"applied_lsn={gate.get('applied_lsn', 0)} "
+                            f"lag_lsn={gate.get('lag_lsn', 0)} "
+                            f"lag_bytes={gate.get('lag_bytes', 0)} "
+                            "(bound: replica_max_staleness_lsn="
+                            f"{self.settings.get('replica_max_staleness_lsn')})")
+                    else:
+                        lines.append(
+                            f"{explain_tag('Replication')}: "
+                            f"role=leader epoch={rstate['epoch']} "
+                            f"followers={len(rstate.get('followers', []))}")
             return ResultSet(["QUERY PLAN"], {"QUERY PLAN": lines},
                              len(lines))
         finally:

@@ -95,6 +95,18 @@ STREAM_BATCH_SHRINKS_TOTAL = "stream_batch_shrinks_total"
 SPILL_PASSES_TOTAL = "spill_passes_total"
 # storage integrity (storage/integrity.py read-path accounting folded
 # in per statement; scrub counters from operations/scrubber.py)
+# replication (replication/ — CDC log shipping leader→followers):
+# batches staged by ship() / rolled in by apply_pending(), followers
+# promoted to leader, zombie-leader ships rejected by epoch fencing,
+# and the follower staleness gate's cumulative observed lag in lsns
+# (the wlm_queue_wait_ms idiom: a lag-sum sample per staleness check —
+# divide by checks for an average; the live per-follower lag is
+# citus_stat_replication's column)
+LOG_BATCHES_SHIPPED_TOTAL = "log_batches_shipped_total"
+LOG_BATCHES_APPLIED_TOTAL = "log_batches_applied_total"
+REPLICAS_PROMOTED_TOTAL = "replicas_promoted_total"
+REPLICATION_FENCED_TOTAL = "replication_fenced_total"
+REPLICA_LAG_LSN = "replica_lag_lsn"
 STRIPES_VERIFIED_TOTAL = "stripes_verified_total"
 CORRUPTION_DETECTED_TOTAL = "corruption_detected_total"
 READ_REPAIRS_TOTAL = "read_repairs_total"
@@ -125,6 +137,8 @@ ALL_COUNTERS = [
     WARMUP_COMPILES_TOTAL,
     OOM_EVENTS_TOTAL, CACHE_EVICTIONS_TOTAL,
     STREAM_BATCH_SHRINKS_TOTAL, SPILL_PASSES_TOTAL,
+    LOG_BATCHES_SHIPPED_TOTAL, LOG_BATCHES_APPLIED_TOTAL,
+    REPLICAS_PROMOTED_TOTAL, REPLICATION_FENCED_TOTAL, REPLICA_LAG_LSN,
     STRIPES_VERIFIED_TOTAL, CORRUPTION_DETECTED_TOTAL,
     READ_REPAIRS_TOTAL, SCRUB_RUNS_TOTAL, SCRUB_REPAIRS_TOTAL,
 ]

@@ -92,6 +92,12 @@ SPAN_NAMES: dict[str, str] = {
     "retry.backoff": "resilience envelope backoff sleep",
     "oom.degrade": "OOM ladder rung application",
     "mesh.degrade": "mesh shrink + failover after device loss",
+    "replication.ship": "leader→follower batch staging (file diff + "
+                        "journal segment + batch.json commit)",
+    "replication.apply": "follower roll-forward of committed batches "
+                         "behind the apply cursor",
+    "replication.promote": "follower→leader promotion: roll forward, "
+                           "fence, epoch bump, role flip",
 }
 
 # phase attribution for the EXPLAIN ANALYZE Timing line and the
@@ -118,10 +124,14 @@ PHASE_OF: dict[str, str] = {
     "retry.backoff": "retry",
     "oom.degrade": "degrade",
     "mesh.degrade": "degrade",
+    "replication.ship": "replication",
+    "replication.apply": "replication",
+    "replication.promote": "replication",
 }
 
 PHASE_ORDER = ("parse", "queue", "plan", "feed", "compile", "device",
-               "combine", "fastpath", "serving", "retry", "degrade")
+               "combine", "fastpath", "serving", "retry", "degrade",
+               "replication")
 
 # spans kept per trace: a runaway statement (thousands of stripes ×
 # columns) truncates instead of growing the ring without bound

@@ -119,6 +119,16 @@ FAULT_POINTS: dict[str, str] = {
         "serving/batcher.py — coalesced point-lookup batch dispatch",
     "serving.cache_fill":
         "serving/result_cache.py — result-cache entry insert",
+    "replication.ship":
+        "replication/shipper.py — batch staging for a follower (a kill "
+        "before batch.json leaves invisible spool debris: pre-batch)",
+    "replication.apply":
+        "replication/applier.py — follower roll-forward (a kill before "
+        "the cursor flip replays the batch idempotently: post-batch)",
+    "replication.promote":
+        "replication/promote.py — follower→leader role flip + epoch "
+        "bump (re-running promote after a kill is safe: apply is "
+        "idempotent and the flip is one checked-JSON write)",
 }
 
 _lock = threading.Lock()

@@ -185,6 +185,16 @@ def append_op(path: str, payload: bytes) -> None:
     _sim_op("append", path, payload=payload)
 
 
+def append_bytes(path: str, payload: bytes) -> None:
+    """Durable append (write + fsync) through the crash seam — the
+    replication applier's journal catch-up primitive.  Append-only by
+    contract: a torn tail from a power cut is resumed byte-exactly by
+    the caller (the applier knows the expected offsets), never
+    truncated."""
+    _sim_op("append", path, payload=payload)
+    _raw_append_bytes(path, payload)
+
+
 def is_tmp_artifact(fname: str) -> bool:
     """True for any in-flight/abandoned temp this module's writers can
     leave behind: ``.aw.*`` tempfiles and ``*.tmp[.<pid>.<tid>]``

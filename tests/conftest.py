@@ -31,7 +31,7 @@ import pytest  # noqa: E402
 _TIER1_FIRST = ("test_lint.py", "test_tools.py", "test_wlm.py",
                 "test_tracing.py", "test_exec_cache.py",
                 "test_multichip.py", "test_mesh_failover.py",
-                "test_scan_pipeline.py",
+                "test_scan_pipeline.py", "test_replication.py",
                 "test_serving.py", "test_integrity.py",
                 "test_crash_torture.py", "test_oom_torture.py")
 

@@ -633,6 +633,30 @@ def generate_serving(rng: random.Random, state: dict) -> tuple:
     return ("read", rng.choice(SERVING_READ_AGGS), None)
 
 
+# ---------------------------------------------------------------------------
+# replica mode: two leader sessions write, a follower replays
+#
+# The replica-fuzz harness (tests/test_replication.py) interleaves
+# DML/COPY/txn writes from TWO leader sessions sharing one data_dir,
+# ships batches to a follower at random points, and at every sync
+# barrier (ship + apply to the caught-up lsn) compares the leader and
+# follower row-for-row — the log-shipping correctness oracle: a
+# follower at lsn L must equal the leader as-of L, byte-for-byte
+# journal included.
+
+
+def generate_replica(rng: random.Random, state: dict) -> tuple:
+    """One replica-fuzz step: ``(kind, sql, rows, writer)`` where kind
+    is the serving-mode op kind ("write" | "copy" | "txn_write" |
+    "read") and ``writer`` picks WHICH of the two leader sessions runs
+    a write (reads run follower-side in the harness).  Reuses the
+    serving op mix — inserts with fresh ids, range updates, hot-key
+    deletes, COPY, transactional updates — because that mix already
+    exercises every CDC record shape the journal can carry."""
+    kind, sql, rows = generate_serving(rng, state)
+    return (kind, sql, rows, rng.randrange(2))
+
+
 def chaos_device_kill(rng: random.Random, device_ids) -> dict:
     """Device-killer actor (chaos mode): pick a victim device and how
     the mesh loses it — sticky kill (preempted chip) or one-shot

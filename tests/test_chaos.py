@@ -106,6 +106,14 @@ FAULT_POOL = [
     dict(name="mesh.fetch", error="device"),
     dict(name="mesh.device_put", error="device"),
     dict(name="mesh.collective", error="device", p=0.5, times=2),
+    # replication seams (PR 18): the soak runs single-directory, so
+    # these trip only if a statement crosses the ship/apply/promote
+    # paths — armed anyway so the pool covers the registry; the
+    # replica-fuzz harness (tests/test_replication.py) arms them
+    # against a live leader→follower pair where they actually fire
+    dict(name="replication.ship"),
+    dict(name="replication.apply"),
+    dict(name="replication.promote"),
 ]
 
 

@@ -88,3 +88,565 @@ def test_split_preserves_replication(sess):
         assert len(ps) == 2, f"shard {s.shard_id} lost its replica"
     total = sum(i * 2 for i in range(1, 401))
     assert int(sess.execute("select sum(v) from r").rows()[0][0]) == total
+
+
+# ===========================================================================
+# CDC log-shipped read replicas (PR 18): leader → follower shipping,
+# bounded visible staleness, promotion + zombie-leader fencing,
+# power-cut torture over the ship/apply seams, and the replica fuzz
+# (leader ≡ follower-at-caught-up-lsn, row for row).
+
+import os
+import random
+import shutil
+
+from citus_tpu.catalog import Catalog
+from citus_tpu.errors import ReadOnlyReplica, ReplicaTooStale, \
+    ReplicationError
+from citus_tpu.replication import (
+    apply_pending,
+    journal_tail_lsn,
+    load_cursor,
+    load_state,
+    promote,
+    provision_replica,
+    ship,
+    ship_all,
+    staleness,
+)
+from citus_tpu.stats import counters as sc
+from citus_tpu.storage import TableStore
+from citus_tpu.utils import faultinjection as fi
+from citus_tpu.utils.crashsim import PowerCut, power_cut_at
+from fuzzer import generate_replica
+
+_QUIET = dict(n_devices=2, recover_2pc_interval_ms=-1,
+              defer_shard_delete_interval_ms=-1,
+              health_check_interval_ms=-1, retry_backoff_base_ms=1)
+
+
+def _connect(path, **kw):
+    merged = dict(_QUIET)
+    merged.update(kw)
+    return citus_tpu.connect(data_dir=str(path), **merged)
+
+
+def _seed_leader(path, rows=30):
+    s = _connect(path)
+    s.execute("CREATE TABLE kv (id INT, v INT)")
+    s.execute("SELECT create_distributed_table('kv', 'id', 4)")
+    s.execute("INSERT INTO kv VALUES " + ", ".join(
+        f"({i}, {i * 3})" for i in range(rows)))
+    return s
+
+
+def _rows(sess, sql="SELECT id, v FROM kv ORDER BY id"):
+    return [(int(a), int(b)) for a, b in sess.execute(sql).rows()]
+
+
+def _rows_cold(data_dir, table="kv"):
+    """Read a data_dir without a Session (the crashed-follower view)."""
+    cat = Catalog.load(os.path.join(data_dir, "catalog.json"))
+    store = TableStore(str(data_dir), cat)
+    out = {}
+    for shard in cat.table_shards(table):
+        vals, _mask, n = store.read_shard(table, shard.shard_id,
+                                          ["id", "v"])
+        for i in range(n):
+            out[int(vals["id"][i])] = int(vals["v"][i])
+    return sorted(out.items())
+
+
+@pytest.fixture
+def pair(tmp_path):
+    """A leader session with seeded rows + a provisioned follower."""
+    lead = str(tmp_path / "leader")
+    foll = str(tmp_path / "replica")
+    s = _seed_leader(lead)
+    provision_replica(lead, foll, counters=s.stats.counters)
+    yield s, lead, foll
+    s.close()
+
+
+class TestProvisionShipApply:
+    def test_provisioned_replica_serves_rows(self, pair):
+        s, lead, foll = pair
+        r = _connect(foll)
+        try:
+            assert _rows(r) == _rows(s)
+            # bounded staleness surface: caught up means lag 0
+            st = staleness(foll)
+            assert st["lag_lsn"] == 0 and st["lag_bytes"] == 0
+        finally:
+            r.close()
+
+    def test_follower_journal_is_byte_identical(self, pair):
+        s, lead, foll = pair
+        s.execute("INSERT INTO kv VALUES (900, 1), (901, 2)")
+        s.execute("DELETE FROM kv WHERE id = 901")
+        ship(lead, foll, counters=s.stats.counters)
+        apply_pending(foll)
+        with open(os.path.join(lead, "cdc_changes.jsonl"), "rb") as f:
+            lj = f.read()
+        with open(os.path.join(foll, "cdc_changes.jsonl"), "rb") as f:
+            fj = f.read()
+        # the follower's copy is a byte-exact PREFIX of the leader's
+        # (equal when nothing committed after the ship)
+        assert fj == lj[: len(fj)] and len(fj) >= 1
+        cur = load_cursor(foll)
+        assert cur["journal_size"] == len(fj)
+        assert cur["applied_lsn"] == journal_tail_lsn(foll)
+
+    def test_read_only_replica_rejects_writes(self, pair):
+        s, lead, foll = pair
+        r = _connect(foll)
+        try:
+            with pytest.raises(ReadOnlyReplica):
+                r.execute("INSERT INTO kv VALUES (999, 1)")
+            with pytest.raises(ReadOnlyReplica):
+                r.execute("UPDATE kv SET v = 0 WHERE id = 1")
+            with pytest.raises(ReadOnlyReplica):
+                r.execute("CREATE TABLE t2 (a INT)")
+            with pytest.raises(ReadOnlyReplica):
+                r.execute("SELECT citus_rebalance_start()")
+            # reads keep answering
+            assert _rows(r) == _rows(s)
+        finally:
+            r.close()
+
+    def test_incremental_ship_and_apply_on_read(self, pair):
+        s, lead, foll = pair
+        r = _connect(foll)
+        try:
+            before = _rows(r)
+            s.execute("INSERT INTO kv VALUES (500, 7)")
+            s.execute("UPDATE kv SET v = v + 1 WHERE id < 3")
+            res = ship(lead, foll, counters=s.stats.counters)
+            assert res["status"] == "shipped" and not res["reseed"]
+            # the follower session drains the spool on its next read —
+            # no restart, no explicit apply call
+            after = _rows(r)
+            assert after == _rows(s) and after != before
+        finally:
+            r.close()
+
+    def test_ship_is_noop_when_caught_up(self, pair):
+        s, lead, foll = pair
+        assert ship(lead, foll)["status"] == "noop"
+
+    def test_dropped_table_ships(self, pair):
+        s, lead, foll = pair
+        s.execute("CREATE TABLE gone (a INT)")
+        s.execute("SELECT create_distributed_table('gone', 'a', 2)")
+        s.execute("INSERT INTO gone VALUES (1)")
+        ship(lead, foll)
+        apply_pending(foll)
+        assert os.path.isdir(os.path.join(foll, "tables", "gone"))
+        s.execute("DROP TABLE gone")
+        ship(lead, foll)
+        apply_pending(foll)
+        assert not os.path.isdir(os.path.join(foll, "tables", "gone"))
+        r = _connect(foll)
+        try:
+            assert "gone" not in r.catalog.tables
+        finally:
+            r.close()
+
+    def test_staleness_gate_raises_replica_too_stale(self, pair):
+        s, lead, foll = pair
+        r = _connect(foll)
+        try:
+            s.execute("INSERT INTO kv VALUES (600, 1)")
+            # nothing shipped yet: the replica is visibly behind
+            lag0 = r.stats.counters.snapshot().get(sc.REPLICA_LAG_LSN, 0)
+            with r.settings.override(replica_max_staleness_lsn=0):
+                with pytest.raises(ReplicaTooStale):
+                    r.execute("SELECT count(*) FROM kv")
+            assert r.stats.counters.snapshot()[sc.REPLICA_LAG_LSN] > lag0
+            # unbounded (-1, the default): old rows are fine
+            assert (500, 7) not in _rows(r) or True
+            # catch up: the same bounded read now answers
+            ship(lead, foll)
+            with r.settings.override(replica_max_staleness_lsn=0):
+                assert _rows(r) == _rows(s)
+        finally:
+            r.close()
+
+    def test_stat_replication_udf_both_roles(self, pair):
+        s, lead, foll = pair
+        s.execute("INSERT INTO kv VALUES (700, 1)")
+        rows = s.execute("SELECT citus_stat_replication()").rows()
+        assert len(rows) == 1
+        peer, role, applied, leader_lsn, lag_lsn, lag_bytes, epoch = \
+            rows[0]
+        assert peer == os.path.realpath(foll) and role == "follower"
+        assert int(lag_lsn) >= 1 and int(lag_bytes) >= 1
+        assert int(leader_lsn) == int(applied) + int(lag_lsn)
+        r = _connect(foll)
+        try:
+            fr = r.execute("SELECT citus_stat_replication()").rows()[0]
+            assert fr[1] == "leader"  # the peer column names the leader
+            assert int(fr[4]) >= 1   # follower sees its own lag too
+        finally:
+            r.close()
+
+    def test_explain_analyze_replication_line(self, pair):
+        s, lead, foll = pair
+        r = _connect(foll)
+        try:
+            text = "\n".join(r.execute(
+                "EXPLAIN ANALYZE SELECT count(*) FROM kv"
+            ).columns["QUERY PLAN"])
+            assert "Replication: role=follower" in text
+            assert "lag_lsn=" in text
+            ltext = "\n".join(s.execute(
+                "EXPLAIN ANALYZE SELECT count(*) FROM kv"
+            ).columns["QUERY PLAN"])
+            assert "Replication: role=leader" in ltext
+            assert "followers=1" in ltext
+        finally:
+            r.close()
+
+    def test_exec_cache_and_caps_memo_ship(self, pair):
+        s, lead, foll = pair
+        # the leader compiled + persisted executables during seeding;
+        # a provisioned replica must hold the same warm artifacts
+        lcache = os.path.join(lead, "exec_cache")
+        if os.path.isdir(lcache):
+            lfiles = sorted(os.listdir(lcache))
+            assert sorted(os.listdir(
+                os.path.join(foll, "exec_cache"))) == lfiles
+        if os.path.exists(os.path.join(lead, "caps_memo.json")):
+            assert os.path.exists(os.path.join(foll, "caps_memo.json"))
+
+
+class TestPromotionAndFencing:
+    def test_promote_serves_writes_and_fences_old_leader(self, pair):
+        s, lead, foll = pair
+        s.execute("INSERT INTO kv VALUES (800, 8)")
+        ship_all(lead, counters=s.stats.counters)
+        r = _connect(foll)
+        try:
+            epoch = r.execute(
+                "SELECT citus_promote_replica()").rows()[0][0]
+            assert int(epoch) == 2
+            assert load_state(foll)["role"] == "leader"
+            # the promoted replica serves writes on the SAME lsn line
+            pre_lsn = journal_tail_lsn(foll)
+            r.execute("INSERT INTO kv VALUES (801, 9)")
+            assert journal_tail_lsn(foll) > pre_lsn
+            assert (801, 9) in _rows(r)
+            # the old leader is fenced: its late ship is rejected and
+            # counted, never applied
+            base = s.stats.counters.snapshot().get(
+                sc.REPLICATION_FENCED_TOTAL, 0)
+            with pytest.raises(ReplicationError, match="fenced"):
+                ship(lead, foll, counters=s.stats.counters)
+            assert s.stats.counters.snapshot()[
+                sc.REPLICATION_FENCED_TOTAL] == base + 1
+            assert s.stats.counters.snapshot()[
+                sc.REPLICAS_PROMOTED_TOTAL] >= 0  # registered
+            assert r.stats.counters.snapshot()[
+                sc.REPLICAS_PROMOTED_TOTAL] == 1
+        finally:
+            r.close()
+
+    def test_zombie_batch_in_spool_rejected_by_applier(self, pair):
+        s, lead, foll = pair
+        promote(foll)  # epoch 2, fence stamped into the old leader
+        # a zombie that never read its fence: simulate by deleting the
+        # fence file (e.g. a partitioned filesystem view) and shipping
+        os.unlink(os.path.join(lead, "replication", "fence.json"))
+        s.execute("INSERT INTO kv VALUES (802, 1)")
+        # shipper-side backstop fires off the follower's newer cursor
+        with pytest.raises(ReplicationError, match="stale"):
+            ship(lead, foll)
+        # force a stale batch PAST the shipper checks: rewind the
+        # follower cursor epoch as the zombie would have seen it
+        cur = load_cursor(foll)
+        from citus_tpu.replication.state import save_cursor
+        save_cursor(foll, dict(cur, epoch=1))
+        ship(lead, foll)
+        save_cursor(foll, cur)  # the real (promoted) cursor returns
+        counters = s.stats.counters
+        base = counters.snapshot().get(sc.REPLICATION_FENCED_TOTAL, 0)
+        res = apply_pending(foll, counters=counters)
+        assert res["fenced"] == 1 and res["applied"] == 0
+        assert counters.snapshot()[
+            sc.REPLICATION_FENCED_TOTAL] == base + 1
+        assert (802, 1) not in dict(_rows_cold(foll)).items()
+
+    def test_promote_is_idempotent_under_directed_fault(self, pair):
+        s, lead, foll = pair
+        with pytest.raises(fi.InjectedFault):
+            with fi.inject("replication.promote", require_fired=True):
+                promote(foll)
+        # the interrupted promotion left a follower; retry completes
+        assert load_state(foll)["role"] == "follower"
+        assert promote(foll) == 2
+        assert load_state(foll)["role"] == "leader"
+
+
+class TestDirectedFaults:
+    def test_ship_fault_fires_and_is_clean(self, pair):
+        s, lead, foll = pair
+        s.execute("INSERT INTO kv VALUES (810, 1)")
+        with pytest.raises(fi.InjectedFault):
+            with fi.inject("replication.ship", require_fired=True):
+                ship(lead, foll)
+        # nothing committed: the follower never sees a half batch
+        assert apply_pending(foll)["applied"] == 0
+        ship(lead, foll)
+        apply_pending(foll)
+        assert (810, 1) in dict(_rows_cold(foll)).items()
+
+    def test_apply_fault_fires_and_retry_lands(self, pair):
+        s, lead, foll = pair
+        s.execute("INSERT INTO kv VALUES (811, 1)")
+        ship(lead, foll)
+        with pytest.raises(fi.InjectedFault):
+            with fi.inject("replication.apply", require_fired=True):
+                apply_pending(foll)
+        # batch still pending; the retry applies it idempotently
+        res = apply_pending(foll)
+        assert res["applied"] == 1
+        assert (811, 1) in dict(_rows_cold(foll)).items()
+
+
+class TestRestoreClusterReplication:
+    def test_restore_on_leader_reseeds_followers(self, tmp_path):
+        from citus_tpu.operations.restore_point import restore_cluster
+
+        lead = str(tmp_path / "leader")
+        foll = str(tmp_path / "replica")
+        s = _seed_leader(lead)
+        s.execute("SELECT citus_create_restore_point('rp')")
+        s.execute("INSERT INTO kv VALUES (900, 1), (901, 2)")
+        provision_replica(lead, foll, counters=s.stats.counters)
+        assert (900, 1) in dict(_rows_cold(foll)).items()
+        old_history = load_state(lead)["history_id"]
+        old_cursor = load_cursor(foll)
+        s.close()
+        restore_cluster(lead, "rp")
+        # the restore rotated the journal history: the follower cursor
+        # (pinned past the wipe) must never replay as a delta
+        new_state = load_state(lead)
+        assert new_state["history_id"] != old_history
+        assert int(old_cursor["applied_lsn"]) > 0
+        s = _connect(lead)
+        try:
+            res = ship(lead, foll, counters=s.stats.counters)
+            assert res["status"] == "shipped" and res["reseed"]
+            apply_pending(foll)
+            assert _rows_cold(foll) == _rows_cold(lead)
+            assert (900, 1) not in dict(_rows_cold(foll)).items()
+            cur = load_cursor(foll)
+            assert cur["history_id"] == new_state["history_id"]
+        finally:
+            s.close()
+
+
+# ---------------------------------------------------------------------------
+# power-cut torture over ship + apply (the CrashSim every-N sweep):
+# cutting power at ANY durable write op of a ship+apply cycle leaves
+# the follower's VISIBLE rows at exactly pre-batch XOR post-batch (the
+# single per-table manifest is the visibility flip), every checksum
+# verifies, and redoing ship+apply converges on post-batch.
+
+
+@pytest.fixture(scope="module")
+def repl_base(tmp_path_factory):
+    """A frozen leader+follower pair with one UNSHIPPED increment:
+    the follower holds the seed rows; the leader added, updated and
+    deleted rows since.  Each crashpoint copies both dirs."""
+    base = tmp_path_factory.mktemp("repl_torture")
+    lead, foll = str(base / "leader"), str(base / "replica")
+    s = _seed_leader(lead, rows=20)
+    provision_replica(lead, foll, counters=s.stats.counters)
+    pre = _rows_cold(foll)
+    s.execute("INSERT INTO kv VALUES (100, 1), (101, 2), (102, 3)")
+    s.execute("UPDATE kv SET v = 999 WHERE id < 4")
+    s.execute("DELETE FROM kv WHERE id = 7")
+    post = _rows_cold(lead)
+    s.close()
+    assert pre != post
+    return lead, foll, pre, post
+
+
+def _ship_apply(lead, foll):
+    ship(lead, foll)
+    return apply_pending(foll)
+
+
+def _torture_one(repl_base, tmp_path, n: int, mode: str | None) -> str:
+    lead, foll, pre, post = repl_base
+    wl = str(tmp_path / f"l{mode or 'cyc'}{n:03d}")
+    wf = str(tmp_path / f"f{mode or 'cyc'}{n:03d}")
+    shutil.copytree(lead, wl)
+    shutil.copytree(foll, wf)
+    with power_cut_at(n, mode=mode) as sim:
+        try:
+            _ship_apply(wl, wf)
+            raise AssertionError(f"op {n} never reached")
+        except PowerCut:
+            pass
+    # the crashed follower's visible rows: exactly pre XOR post (reads
+    # CRC-verify every stripe — a torn file would refuse, not lie)
+    got = _rows_cold(wf)
+    assert got in (pre, post), (
+        f"crash at op {n} (tear={sim.tear_applied}): follower is "
+        f"neither pre- nor post-batch\n got: {got}")
+    # cold redo (the follower process restarting): converges on post
+    res = _ship_apply(wl, wf)
+    assert _rows_cold(wf) == post, f"redo after op {n} did not land"
+    # journal byte-identical after catch-up, cursor committed
+    with open(os.path.join(wl, "cdc_changes.jsonl"), "rb") as f:
+        lj = f.read()
+    with open(os.path.join(wf, "cdc_changes.jsonl"), "rb") as f:
+        fj = f.read()
+    assert fj == lj, f"follower journal diverged after crash at op {n}"
+    assert not apply_pending(wf)["applied"], "spool not drained"
+    shutil.rmtree(wl, ignore_errors=True)
+    shutil.rmtree(wf, ignore_errors=True)
+    return sim.tear_applied or "none"
+
+
+def _rehearse_repl(repl_base, tmp_path) -> int:
+    lead, foll, _pre, post = repl_base
+    wl, wf = str(tmp_path / "rl"), str(tmp_path / "rf")
+    shutil.copytree(lead, wl)
+    shutil.copytree(foll, wf)
+    with power_cut_at(None) as sim:
+        _ship_apply(wl, wf)
+    assert _rows_cold(wf) == post
+    shutil.rmtree(wl, ignore_errors=True)
+    shutil.rmtree(wf, ignore_errors=True)
+    assert sim.ops >= 8, f"ship+apply too small to sweep ({sim.ops})"
+    return sim.ops
+
+
+class TestShipApplyPowerCut:
+    def test_tier1_every_op_cycled_tears(self, repl_base, tmp_path):
+        """EVERY durable write op of one ship+apply cycle, tear mode
+        cycled deterministically by op index."""
+        total = _rehearse_repl(repl_base, tmp_path)
+        modes = set()
+        for n in range(1, total + 1):
+            modes.add(_torture_one(repl_base, tmp_path, n, None))
+        assert modes >= {"lost", "torn", "complete"}
+
+    @pytest.mark.slow
+    def test_full_sweep_every_mode(self, repl_base, tmp_path):
+        """Acceptance: every op × every forced tear mode."""
+        total = _rehearse_repl(repl_base, tmp_path)
+        for mode in ("lost", "torn", "complete"):
+            for n in range(1, total + 1):
+                _torture_one(repl_base, tmp_path, n, mode)
+
+
+# ---------------------------------------------------------------------------
+# replica fuzz: leader ≡ follower-at-caught-up-lsn, row for row, under
+# interleaved DML / COPY / transactional writes from TWO leader
+# sessions.  Chaos actors: replica-kill (the follower session dies
+# abruptly mid-storm and a cold successor must answer identically) and
+# leader-kill (promotion mid-storm; the promoted replica must hold
+# exactly the rows of the last synced lsn — the zero-wrong-rows
+# oracle).
+
+
+def _sync(lead, foll, counters=None):
+    """Ship until the spool drains to a noop — the caught-up barrier."""
+    for _ in range(6):
+        res = ship(lead, foll, counters=counters)
+        apply_pending(foll, counters=counters)
+        if res["status"] == "noop":
+            return
+    raise AssertionError("ship never reached noop with writers idle")
+
+
+def _run_replica_fuzz(tmp_path, n_ops: int, seed: int,
+                      kill_replica: bool = False,
+                      kill_leader: bool = False) -> dict:
+    lead = str(tmp_path / "leader")
+    foll = str(tmp_path / "replica")
+    w = [_seed_leader(lead, rows=60), _connect(lead)]
+    provision_replica(lead, foll, counters=w[0].stats.counters)
+    reader = _connect(foll)
+    rng = random.Random(seed)
+    state = {"next_id": 60}
+    stats = {"reads": 0, "writes": 0, "syncs": 0, "kills": 0}
+    try:
+        for op in range(n_ops):
+            kind, sql, rows, who = generate_replica(rng, state)
+            if kind == "copy":
+                csv = str(tmp_path / f"rf_{op}.csv")
+                with open(csv, "w") as f:
+                    for i, v in rows:
+                        f.write(f"{i},{v}\n")
+                sql = f"COPY kv FROM '{csv}' WITH (FORMAT csv)"
+                kind = "write"
+            if kind == "txn_write":
+                w[who].execute("BEGIN")
+                w[who].execute(sql)
+                w[who].execute("COMMIT")
+                stats["writes"] += 1
+                continue
+            if kind == "write":
+                w[who].execute(sql)
+                stats["writes"] += 1
+                continue
+            # a read op is a sync barrier: catch the follower up to
+            # the leader's lsn, then the replica must answer the
+            # generated read AND the full table row-for-row
+            stats["reads"] += 1
+            stats["syncs"] += 1
+            if kill_replica and rng.random() < 0.2:
+                # replica-kill actor: abrupt session death (threads
+                # stopped, nothing saved), cold successor takes over
+                reader.maintenance.stop()
+                reader.jobs.shutdown()
+                reader = _connect(foll)
+                stats["kills"] += 1
+            _sync(lead, foll, counters=w[0].stats.counters)
+            assert sorted(reader.execute(sql).rows()) == \
+                sorted(w[who].execute(sql).rows()), \
+                f"replica diverged on {sql!r} (step {op})"
+            assert _rows(reader) == _rows(w[0]), \
+                f"row-for-row divergence at step {op}"
+        _sync(lead, foll, counters=w[0].stats.counters)
+        oracle = _rows(w[0])
+        assert _rows(reader) == oracle
+        if kill_leader:
+            # leader-kill actor: both leader sessions die; the
+            # follower promotes and must hold EXACTLY the synced rows
+            for s in w:
+                s.maintenance.stop()
+                s.jobs.shutdown()
+            reader.execute("SELECT citus_promote_replica()")
+            assert _rows(reader) == oracle, \
+                "promotion changed visible rows (wrong-rows oracle)"
+            reader.execute("INSERT INTO kv VALUES (999999, 1)")
+            assert (999999, 1) in _rows(reader)
+            with pytest.raises(ReplicationError):
+                ship(lead, foll)  # the zombie stays fenced
+        return stats
+    finally:
+        reader.close()
+        for s in w:
+            s.close()
+
+
+def test_replica_fuzz_smoke_slice(tmp_path):
+    """Deterministic tier-1 slice: two leader sessions interleave
+    DML/COPY/txn writes; at every sync barrier the follower equals the
+    leader row-for-row at the caught-up lsn."""
+    stats = _run_replica_fuzz(tmp_path, n_ops=45, seed=1806)
+    assert stats["writes"] >= 8 and stats["syncs"] >= 10
+
+
+@pytest.mark.slow
+def test_replica_fuzz_full(tmp_path):
+    stats = _run_replica_fuzz(tmp_path, n_ops=250, seed=20260806,
+                              kill_replica=True, kill_leader=True)
+    assert stats["writes"] >= 40 and stats["syncs"] >= 60
+    assert stats["kills"] >= 1
